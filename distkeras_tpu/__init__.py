@@ -1,0 +1,21 @@
+"""distkeras_tpu — a TPU-native framework with dist-keras's capabilities.
+
+The reference (FranNetty/dist-keras, presumed fork of cerndb/dist-keras)
+glues Keras to Spark with a socket parameter server; this framework provides
+the same trainer zoo, data transformers, predictor, and evaluators rebuilt on
+jax/XLA: jit-compiled update steps, mesh-sharded replicas, and ICI collectives
+instead of TCP+pickle. See SURVEY.md for the layer-by-layer mapping.
+"""
+
+__version__ = "0.1.0"
+
+from distkeras_tpu.data.dataset import Dataset, synthetic_mnist
+from distkeras_tpu.trainers import SingleTrainer, Trainer
+
+__all__ = [
+    "Dataset",
+    "SingleTrainer",
+    "Trainer",
+    "synthetic_mnist",
+    "__version__",
+]

@@ -1,0 +1,3 @@
+from distkeras_tpu.data.dataset import Dataset, synthetic_mnist
+
+__all__ = ["Dataset", "synthetic_mnist"]

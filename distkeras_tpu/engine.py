@@ -1,0 +1,131 @@
+"""Core step engine: TrainState + jit-compiled update steps.
+
+This is the TPU-native replacement for what the reference delegates to Keras:
+``model.compile`` + ``train_on_batch`` inside each Spark executor
+(``distkeras/workers.py`` — unverified, mount empty; see SURVEY.md). Instead
+of an eager per-batch call into a TF1 session, the whole update step —
+forward, backward, optimizer — is a single pure function traced once by XLA,
+so it tiles onto the MXU and fuses elementwise work into the matmuls.
+
+Design rules honored here:
+- static shapes only; the data pipeline pads/drops ragged tails,
+- no Python control flow inside the step,
+- state is donated so XLA updates parameters in place in HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from distkeras_tpu.ops import losses as losses_lib
+from distkeras_tpu.utils.trees import global_norm
+
+Batch = dict  # {"features": ..., "labels": ...} plus model-specific keys
+ApplyFn = Callable[..., jax.Array]
+
+
+@struct.dataclass
+class TrainState:
+    """Replicated training state: the analogue of one worker's compiled model.
+
+    The parameter-server 'center variable' of the reference is a TrainState's
+    ``params`` living replicated (or sharded) on device, not a pickled dict on
+    a driver socket thread.
+    """
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def create_train_state(model, rng, sample_batch: Batch,
+                       tx: optax.GradientTransformation) -> TrainState:
+    """Initialize params + optimizer state from a sample batch (shapes only)."""
+    x = sample_batch["features"]
+    variables = model.init(rng, x, train=False)
+    params = variables["params"]
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                      opt_state=tx.init(params))
+
+
+def make_loss_fn(model, loss) -> Callable:
+    """(params, batch, rngs) -> (scalar loss, logits). Resolves Keras-style
+    loss names. Logits ride along as aux so metrics reuse the forward pass."""
+    loss_fn = losses_lib.get(loss)
+
+    def compute(params, batch: Batch, rngs: Optional[dict] = None):
+        kwargs = {"rngs": rngs} if rngs else {}
+        logits = model.apply({"params": params}, batch["features"], train=True,
+                             **kwargs)
+        return loss_fn(logits, batch["labels"]), logits
+
+    return compute
+
+
+def compute_metric(name: str, logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Keras-style training metrics over one batch."""
+    if name in ("accuracy", "acc", "categorical_accuracy"):
+        pred = jnp.argmax(logits, axis=-1)
+        true = labels if labels.ndim == logits.ndim - 1 else jnp.argmax(labels, axis=-1)
+        return jnp.mean((pred == true).astype(jnp.float32))
+    if name == "loss":  # already reported separately
+        raise ValueError("'loss' is always recorded; don't list it in metrics")
+    raise ValueError(f"Unknown metric {name!r}; supported: 'accuracy'")
+
+
+def make_train_step(model, loss, tx: optax.GradientTransformation,
+                    with_metrics: bool = True,
+                    metrics: tuple = (),
+                    dropout_seed: int = 0) -> Callable:
+    """Build the jitted single-replica train step.
+
+    Returns ``step(state, batch) -> (state, metrics)`` where metrics is a dict
+    of scalar device arrays (loss, grad_norm, requested metrics). Already
+    jitted with donated state. A per-step dropout rng is derived by folding
+    the step counter into ``dropout_seed``, so stochastic layers just work.
+    """
+    compute_loss = make_loss_fn(model, loss)
+    base_key = jax.random.key(dropout_seed)
+
+    def step(state: TrainState, batch: Batch) -> tuple[TrainState, dict]:
+        rngs = {"dropout": jax.random.fold_in(base_key, state.step)}
+        (loss_val, logits), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(state.params, batch, rngs)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(step=state.step + 1, params=params,
+                               opt_state=opt_state)
+        out = {"loss": loss_val}
+        if with_metrics:
+            out["grad_norm"] = global_norm(grads)
+        for name in metrics:
+            out[name] = compute_metric(name, logits, batch["labels"])
+        return new_state, out
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def make_grad_fn(model, loss) -> Callable:
+    """(params, batch) -> ((loss, logits), grads); building block for the
+    parallel substrate where the optimizer application happens per-strategy."""
+    compute_loss = make_loss_fn(model, loss)
+
+    def grad_fn(params, batch: Batch, rngs: Optional[dict] = None):
+        return jax.value_and_grad(compute_loss, has_aux=True)(
+            params, batch, rngs)
+
+    return grad_fn
+
+
+def make_eval_step(model) -> Callable:
+    """Jitted forward pass: (params, features) -> logits."""
+
+    def forward(params, x):
+        return model.apply({"params": params}, x, train=False)
+
+    return jax.jit(forward)

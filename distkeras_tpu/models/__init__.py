@@ -1,0 +1,3 @@
+from distkeras_tpu.models.mlp import MLP, mnist_mlp
+
+__all__ = ["MLP", "mnist_mlp"]

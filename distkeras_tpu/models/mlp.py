@@ -1,0 +1,39 @@
+"""MLP — the reference's MNIST example model family.
+
+The reference defines models in example scripts with Keras Sequential
+(Dense/Dropout stacks for MNIST/ATLAS-Higgs); this framework ships the model
+zoo in-tree. BASELINE config 1 is "MNIST MLP, ADAG single-worker".
+
+TPU notes: hidden widths default to multiples of 128 to fill MXU lanes;
+compute dtype is configurable (bfloat16 for TPU, float32 params).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (512, 256)
+    num_classes: int = 10
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, width in enumerate(self.features):
+            x = nn.Dense(width, dtype=self.dtype, name=f"dense_{i}")(x)
+            x = nn.relu(x)
+            if self.dropout_rate > 0.0:
+                x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def mnist_mlp(**kw) -> MLP:
+    """The BASELINE config-1 model: 784 -> 512 -> 256 -> 10."""
+    return MLP(**kw)

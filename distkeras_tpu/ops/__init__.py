@@ -1,0 +1,3 @@
+from distkeras_tpu.ops import losses, optimizers
+
+__all__ = ["losses", "optimizers"]

@@ -1,0 +1,72 @@
+"""Loss functions, resolvable by Keras-style string names.
+
+Reference parity: dist-keras passes Keras loss names straight into
+``model.compile(loss=...)`` (``distkeras/trainers.py`` ctor kwarg ``loss`` —
+unverified, mount empty). Here losses are pure jnp functions over *logits*
+(numerically stabler than probabilities and lets XLA fuse the softmax into
+the crossentropy) with the same names accepted.
+
+Every loss has signature ``loss(logits, labels) -> scalar`` (mean over batch).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+LossFn = Callable[[jax.Array, jax.Array], jax.Array]
+
+
+def categorical_crossentropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Softmax crossentropy with one-hot (or soft) labels."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+
+
+def sparse_categorical_crossentropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Softmax crossentropy with integer class labels."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)
+    return -jnp.mean(ll)
+
+
+def binary_crossentropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Sigmoid crossentropy; labels in {0,1} with shape broadcastable to logits."""
+    labels = labels.astype(logits.dtype)
+    # log(1+exp(-|x|)) formulation for stability
+    return jnp.mean(
+        jnp.maximum(logits, 0.0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    )
+
+
+def mean_squared_error(preds: jax.Array, targets: jax.Array) -> jax.Array:
+    return jnp.mean(jnp.square(preds - targets))
+
+
+def mean_absolute_error(preds: jax.Array, targets: jax.Array) -> jax.Array:
+    return jnp.mean(jnp.abs(preds - targets))
+
+
+_LOSSES: dict[str, LossFn] = {
+    "categorical_crossentropy": categorical_crossentropy,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "binary_crossentropy": binary_crossentropy,
+    "mse": mean_squared_error,
+    "mean_squared_error": mean_squared_error,
+    "mae": mean_absolute_error,
+    "mean_absolute_error": mean_absolute_error,
+}
+
+
+def get(loss: Union[str, LossFn]) -> LossFn:
+    """Resolve a loss by Keras-style name, or pass a callable through."""
+    if callable(loss):
+        return loss
+    try:
+        return _LOSSES[loss]
+    except KeyError:
+        raise ValueError(
+            f"Unknown loss {loss!r}; available: {sorted(_LOSSES)}"
+        ) from None

@@ -1,0 +1,43 @@
+"""Worker-optimizer resolution, Keras-string compatible.
+
+Reference parity: dist-keras trainers take ``worker_optimizer`` as a Keras
+optimizer name or instance and hand it to ``model.compile`` on each executor
+(``distkeras/trainers.py``/``workers.py`` — unverified, mount empty). Here the
+same strings resolve to optax gradient transformations; any
+``optax.GradientTransformation`` passes through untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import optax
+
+
+def get(optimizer: Union[str, optax.GradientTransformation],
+        learning_rate: float = 0.01,
+        momentum: float = 0.9) -> optax.GradientTransformation:
+    """Resolve an optimizer. Strings mirror Keras names; default lr matches
+    Keras-1-era SGD (0.01), the reference's de-facto default."""
+    if not isinstance(optimizer, str):
+        return optimizer
+    name = optimizer.lower()
+    if name == "sgd":
+        return optax.sgd(learning_rate)
+    if name in ("momentum", "sgd_momentum"):
+        return optax.sgd(learning_rate, momentum=momentum)
+    if name == "nesterov":
+        return optax.sgd(learning_rate, momentum=momentum, nesterov=True)
+    if name == "adam":
+        return optax.adam(learning_rate)
+    if name == "adamw":
+        return optax.adamw(learning_rate)
+    if name == "adagrad":
+        return optax.adagrad(learning_rate)
+    if name == "rmsprop":
+        return optax.rmsprop(learning_rate)
+    if name == "adadelta":
+        return optax.adadelta(learning_rate)
+    if name == "lamb":
+        return optax.lamb(learning_rate)
+    raise ValueError(f"Unknown optimizer {optimizer!r}")

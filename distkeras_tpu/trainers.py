@@ -1,0 +1,132 @@
+"""Trainer API — the public face of the framework.
+
+Reference parity: ``distkeras/trainers.py`` (unverified, mount empty; see
+SURVEY.md §2) defines ``Trainer`` and its zoo: ``SingleTrainer``,
+``AveragingTrainer``, ``EnsembleTrainer``, and the async family ``DOWNPOUR``,
+``ADAG``, ``AEASGD``, ``EAMSGD``, ``DynSGD``. The constructor-kwargs shape is
+kept (model, loss, worker_optimizer, num_workers, batch_size,
+communication_window, ...), but execution is TPU-native:
+
+- a Spark executor becomes a *model replica* living on a mesh axis,
+- ``mapPartitionsWithIndex(worker.train)`` becomes a ``shard_map``-ed,
+  ``lax.scan``-ed local-step loop compiled once by XLA,
+- the socket parameter server becomes device-resident center state updated by
+  collective folds (see distkeras_tpu/parallel/),
+- per-worker Keras History becomes structured jnp metrics stacked per step.
+
+``trainer.train(dataset)`` returns the trained params pytree; the trainer
+also retains ``params``, ``history`` and ``training_time`` (parity with the
+reference's ``record_training_time`` bookkeeping).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import numpy as np
+import optax
+
+from distkeras_tpu import engine
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.ops import losses as losses_lib
+from distkeras_tpu.ops import optimizers as opt_lib
+
+
+class Trainer:
+    """Base trainer: holds the model spec, loss, worker optimizer, and
+    training-time/history bookkeeping."""
+
+    def __init__(self, model, loss: Union[str, Any] = "categorical_crossentropy",
+                 worker_optimizer: Union[str, optax.GradientTransformation] = "sgd",
+                 learning_rate: float = 0.01,
+                 metrics: Sequence[str] = ("accuracy",),
+                 features_col: str = "features", label_col: str = "label",
+                 batch_size: int = 32, num_epoch: int = 1, seed: int = 0):
+        self.model = model
+        self.loss = loss
+        self.worker_optimizer = worker_optimizer
+        self.learning_rate = learning_rate
+        self.metrics = tuple(metrics)
+        self.features_col = features_col
+        self.label_col = label_col
+        self.batch_size = int(batch_size)
+        self.num_epoch = int(num_epoch)
+        self.seed = int(seed)
+
+        self.tx = opt_lib.get(worker_optimizer, learning_rate)
+        losses_lib.get(loss)  # fail fast on unknown loss names
+        self.params = None
+        self.history: list[dict] = []
+        self.training_time: float = 0.0
+
+    # -- bookkeeping (record_training_time parity) -------------------------
+    def _start(self):
+        self._t0 = time.perf_counter()
+
+    def _stop(self):
+        self.training_time = time.perf_counter() - self._t0
+
+    def get_training_time(self) -> float:
+        return self.training_time
+
+    def get_history(self) -> list[dict]:
+        return self.history
+
+    def get_averaged_history(self) -> dict:
+        """history_executors_average parity: mean of each metric over steps
+        (and over workers, where worker-major histories are recorded)."""
+        if not self.history:
+            return {}
+        keys = self.history[0].keys()
+        return {k: float(np.mean([h[k] for h in self.history])) for k in keys}
+
+    # -- shared plumbing ----------------------------------------------------
+    def _init_params(self, dataset: Dataset):
+        sample = next(dataset.batches(min(self.batch_size, len(dataset)),
+                                      cols=[self.features_col]))
+        batch = {"features": sample[self.features_col]}
+        rng = jax.random.key(self.seed)
+        state = engine.create_train_state(self.model, rng, batch, self.tx)
+        return state
+
+    def _batch_dict(self, raw: dict) -> dict:
+        return {"features": raw[self.features_col],
+                "labels": raw[self.label_col]}
+
+    def _check_trainable(self, dataset: Dataset, effective_batch: int):
+        if len(dataset) < effective_batch:
+            raise ValueError(
+                f"Dataset has {len(dataset)} rows but one step needs "
+                f"{effective_batch}; no full batch can be formed "
+                f"(static-shape batching drops the ragged tail)")
+
+    def train(self, dataset: Dataset, shuffle: bool = False):
+        raise NotImplementedError
+
+
+class SingleTrainer(Trainer):
+    """One replica, plain minibatch SGD — the reference's minimum slice
+    (SingleTrainer: coalesce to one partition, train locally)."""
+
+    def train(self, dataset: Dataset, shuffle: bool = False):
+        self._start()
+        if shuffle:
+            dataset = dataset.shuffle(self.seed)
+        self._check_trainable(dataset, self.batch_size)
+        state = self._init_params(dataset)
+        step_fn = engine.make_train_step(self.model, self.loss, self.tx,
+                                         metrics=self.metrics,
+                                         dropout_seed=self.seed)
+        device_history = []  # device arrays; fetched once at the end so the
+        for epoch in range(self.num_epoch):  # hot loop never blocks on host
+            for raw in dataset.batches(self.batch_size,
+                                       cols=[self.features_col, self.label_col]):
+                state, m = step_fn(state, self._batch_dict(raw))
+                device_history.append(m)
+        self.history = [{k: float(v) for k, v in h.items()}
+                        for h in jax.device_get(device_history)]
+        self.params = jax.device_get(state.params)
+        self._stop()
+        return self.params

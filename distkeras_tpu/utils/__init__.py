@@ -1,0 +1,29 @@
+from distkeras_tpu.utils.trees import (
+    global_norm,
+    tree_add,
+    tree_axpy,
+    tree_bytes,
+    tree_cast,
+    tree_lerp,
+    tree_mean,
+    tree_scale,
+    tree_size,
+    tree_sub,
+    tree_weighted_sum,
+    tree_zeros_like,
+)
+
+__all__ = [
+    "global_norm",
+    "tree_add",
+    "tree_axpy",
+    "tree_bytes",
+    "tree_cast",
+    "tree_lerp",
+    "tree_mean",
+    "tree_scale",
+    "tree_size",
+    "tree_sub",
+    "tree_weighted_sum",
+    "tree_zeros_like",
+]

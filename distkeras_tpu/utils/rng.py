@@ -1,0 +1,28 @@
+"""Deterministic RNG helpers.
+
+dist-keras leans on NumPy global RNG and Spark shuffle nondeterminism; the
+TPU-native build makes every stochastic choice (init, shuffle, worker window
+schedules) an explicit function of a seed so multi-chip runs are replayable.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def key(seed: int) -> jax.Array:
+    return jax.random.key(seed)
+
+
+def split(k, n: int = 2):
+    return jax.random.split(k, n)
+
+
+def np_rng(seed: int) -> np.random.Generator:
+    return np.random.default_rng(seed)
+
+
+def permutation(seed: int, n: int) -> np.ndarray:
+    """Host-side permutation for dataset shuffling (utils.shuffle parity)."""
+    return np.random.default_rng(seed).permutation(n)

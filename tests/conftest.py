@@ -1,0 +1,28 @@
+"""Test harness config: run everything on a virtual 8-device CPU mesh.
+
+This is the TPU-native analogue of the reference's Spark local[N] mode (its
+only multi-worker-without-a-cluster story, per SURVEY.md §4): N XLA host
+devices stand in for N TPU chips so every sharding/collective path compiles
+and executes without hardware.
+
+Must run before any jax import, hence the env mutation at module scope.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs

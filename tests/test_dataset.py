@@ -1,0 +1,55 @@
+import numpy as np
+import pytest
+
+from distkeras_tpu.data.dataset import Dataset, synthetic_mnist
+
+
+def test_columns_and_len():
+    ds = Dataset.from_arrays(a=np.arange(10), b=np.ones((10, 3)))
+    assert len(ds) == 10
+    assert set(ds.columns) == {"a", "b"}
+    assert "a" in ds
+
+
+def test_length_mismatch_raises():
+    with pytest.raises(ValueError):
+        Dataset.from_arrays(a=np.arange(10), b=np.arange(9))
+
+
+def test_shuffle_deterministic_and_permutes():
+    ds = Dataset.from_arrays(a=np.arange(100))
+    s1, s2 = ds.shuffle(7), ds.shuffle(7)
+    np.testing.assert_array_equal(s1["a"], s2["a"])
+    assert not np.array_equal(s1["a"], np.arange(100))
+    np.testing.assert_array_equal(np.sort(s1["a"]), np.arange(100))
+
+
+def test_repartition_covers_all_rows():
+    ds = Dataset.from_arrays(a=np.arange(103))
+    parts = ds.repartition(8)
+    assert len(parts) == 8
+    total = np.concatenate([p["a"] for p in parts])
+    np.testing.assert_array_equal(np.sort(total), np.arange(103))
+
+
+def test_batches_static_shape():
+    ds = Dataset.from_arrays(a=np.arange(100))
+    bs = list(ds.batches(32))
+    assert len(bs) == 3  # ragged tail dropped
+    assert all(b["a"].shape == (32,) for b in bs)
+    bs = list(ds.batches(32, drop_remainder=False))
+    assert len(bs) == 4 and bs[-1]["a"].shape == (4,)
+
+
+def test_with_column_immutable():
+    ds = Dataset.from_arrays(a=np.arange(5))
+    ds2 = ds.with_column("b", np.arange(5) * 2)
+    assert "b" in ds2 and "b" not in ds
+
+
+def test_synthetic_mnist_learnable_shapes():
+    ds = synthetic_mnist(n=256)
+    assert ds["features"].shape == (256, 784)
+    assert ds["label"].shape == (256, 10)
+    assert ds["label_index"].shape == (256,)
+    np.testing.assert_array_equal(ds["label"].argmax(-1), ds["label_index"])

@@ -1,0 +1,51 @@
+import jax
+import numpy as np
+import optax
+
+from distkeras_tpu import engine
+from distkeras_tpu.models.mlp import MLP
+from distkeras_tpu.utils import serialization as ser
+
+
+def _params():
+    model = MLP(features=(8,), num_classes=3)
+    batch = {"features": np.zeros((2, 12), np.float32)}
+    state = engine.create_train_state(model, jax.random.key(0), batch,
+                                      optax.sgd(0.1))
+    return model, state.params
+
+
+def test_params_roundtrip():
+    _, params = _params()
+    blob = ser.serialize_params(params)
+    assert isinstance(blob, bytes) and len(blob) > 0
+    restored = ser.deserialize_params(blob, like=params)
+    jax.tree.map(np.testing.assert_array_equal, params, restored)
+
+
+def test_params_roundtrip_without_like():
+    _, params = _params()
+    restored = ser.deserialize_params(ser.serialize_params(params))
+    np.testing.assert_array_equal(
+        restored["dense_0"]["kernel"], np.asarray(params["dense_0"]["kernel"]))
+
+
+def test_model_roundtrip():
+    model, params = _params()
+    blob = ser.serialize_model(model, params)
+    model2, params2 = ser.deserialize_model(blob)
+    assert type(model2).__name__ == "MLP"
+    assert model2.features == (8,)
+    assert model2.num_classes == 3
+    x = np.ones((4, 12), np.float32)
+    y1 = model.apply({"params": params}, x, train=False)
+    y2 = model2.apply({"params": params2}, x, train=False)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-6)
+
+
+def test_uniform_weights_reinit():
+    _, params = _params()
+    fresh = ser.uniform_weights(params, jax.random.key(1), -0.5, 0.5)
+    kernel = np.asarray(fresh["dense_0"]["kernel"])
+    assert kernel.min() >= -0.5 and kernel.max() <= 0.5
+    assert not np.array_equal(kernel, np.asarray(params["dense_0"]["kernel"]))

@@ -1,0 +1,41 @@
+import numpy as np
+
+from distkeras_tpu.data.dataset import synthetic_mnist
+from distkeras_tpu.models.mlp import MLP
+from distkeras_tpu.trainers import SingleTrainer
+
+
+def test_single_trainer_mnist_converges():
+    ds = synthetic_mnist(n=1024, seed=0)
+    model = MLP(features=(64,), num_classes=10)
+    trainer = SingleTrainer(model, loss="categorical_crossentropy",
+                            worker_optimizer="momentum", learning_rate=0.1,
+                            batch_size=128, num_epoch=5)
+    params = trainer.train(ds)
+    hist = trainer.get_history()
+    assert len(hist) == 5 * (1024 // 128)
+    assert hist[-1]["loss"] < hist[0]["loss"] * 0.7
+    assert trainer.get_training_time() > 0
+    avg = trainer.get_averaged_history()
+    assert "loss" in avg and np.isfinite(avg["loss"])
+    assert params is trainer.params
+
+
+def test_single_trainer_shuffle_flag():
+    ds = synthetic_mnist(n=512, seed=1)
+    model = MLP(features=(32,), num_classes=10)
+    t = SingleTrainer(model, learning_rate=0.05, batch_size=64, num_epoch=1)
+    params = t.train(ds, shuffle=True)
+    assert params is not None
+
+
+def test_dropout_and_accuracy_metric():
+    ds = synthetic_mnist(n=512, seed=2)
+    model = MLP(features=(64,), num_classes=10, dropout_rate=0.2)
+    t = SingleTrainer(model, worker_optimizer="momentum", learning_rate=0.1,
+                      metrics=("accuracy",), batch_size=64, num_epoch=4)
+    t.train(ds)
+    hist = t.get_history()
+    assert "accuracy" in hist[0]
+    assert hist[-1]["accuracy"] > hist[0]["accuracy"]
+    assert 0.0 <= hist[0]["accuracy"] <= 1.0
