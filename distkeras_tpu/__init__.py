@@ -10,10 +10,29 @@ instead of TCP+pickle. See SURVEY.md for the layer-by-layer mapping.
 __version__ = "0.1.0"
 
 from distkeras_tpu.data.dataset import Dataset, synthetic_mnist
-from distkeras_tpu.trainers import SingleTrainer, Trainer
+from distkeras_tpu.trainers import (
+    ADAG,
+    AEASGD,
+    DOWNPOUR,
+    AveragingTrainer,
+    DistributedTrainer,
+    DynSGD,
+    EAMSGD,
+    EnsembleTrainer,
+    SingleTrainer,
+    Trainer,
+)
 
 __all__ = [
+    "ADAG",
+    "AEASGD",
+    "AveragingTrainer",
+    "DOWNPOUR",
     "Dataset",
+    "DistributedTrainer",
+    "DynSGD",
+    "EAMSGD",
+    "EnsembleTrainer",
     "SingleTrainer",
     "Trainer",
     "synthetic_mnist",

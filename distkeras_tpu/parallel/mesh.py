@@ -1,0 +1,59 @@
+"""Device mesh construction — the cluster abstraction.
+
+Reference parity: dist-keras's "cluster" is Spark executors plus a driver
+socket (``distkeras/networking.py`` host/port discovery — unverified, mount
+empty). Here the cluster is a ``jax.sharding.Mesh``: the ``workers`` axis
+carries data-parallel replicas (one per chip or per chip-group), and an
+optional ``model`` axis is reserved for tensor-sharded large models. ICI/DCN
+topology is XLA's problem; collectives ride the mesh axes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+WORKER_AXIS = "workers"
+MODEL_AXIS = "model"
+
+
+def make_mesh(num_workers: Optional[int] = None,
+              model_parallelism: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a (workers, model) mesh over available devices.
+
+    ``num_workers=None`` uses every device for data parallelism — the analogue
+    of the reference defaulting num_workers to the executor count.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if num_workers is None:
+        num_workers = len(devices) // model_parallelism
+    need = num_workers * model_parallelism
+    if need > len(devices):
+        raise ValueError(
+            f"Mesh needs {need} devices ({num_workers} workers x "
+            f"{model_parallelism} model shards) but only {len(devices)} "
+            f"are visible")
+    grid = np.asarray(devices[:need]).reshape(num_workers, model_parallelism)
+    return Mesh(grid, (WORKER_AXIS, MODEL_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def worker_sharded(mesh: Mesh) -> NamedSharding:
+    """Shard the leading axis over workers, replicate the rest."""
+    return NamedSharding(mesh, P(WORKER_AXIS))
+
+
+def put_replicated(tree, mesh: Mesh):
+    return jax.device_put(tree, replicated(mesh))
+
+
+def put_worker_sharded(tree, mesh: Mesh):
+    """Place a pytree whose leaves all have a leading ``workers`` axis."""
+    return jax.device_put(tree, worker_sharded(mesh))

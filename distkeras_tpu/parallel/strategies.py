@@ -1,0 +1,209 @@
+"""Update-rule algebra for the async trainer zoo — "workers.py" re-derived.
+
+Reference parity: each dist-keras algorithm pairs a Worker loop
+(``distkeras/workers.py``) with a parameter-server policy
+(``distkeras/parameter_servers.py``) — both unverified (mount empty); the
+exact rules implemented here are pinned in NUMERICS.md with their paper
+provenance and enforced by golden tests.
+
+Design: a Strategy is a bundle of PURE pytree functions — no sockets, no
+threads, no device placement. The parallel substrate lifts them onto a mesh
+(shard_map + psum); the golden tests run them sequentially on CPU. This split
+is what makes the async algebra unit-testable, which the reference never was
+(SURVEY.md §4: it had no tests at all).
+
+Round shape shared by all strategies (λ = communication_window):
+
+    round_start -> λ × local_step -> commit -> [server: c += Σ s_k·commit_k]
+    -> post_commit
+
+The center fold is additive, so the substrate can apply it with one psum of
+staleness-weighted commits per round.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from distkeras_tpu.utils.trees import tree_add, tree_scale, tree_sub, tree_zeros_like
+
+
+class Carry(NamedTuple):
+    """Per-worker replica state threaded through scans."""
+    params: Any
+    opt_state: Any
+    extra: Any  # strategy-private (e.g. EAMSGD velocity)
+
+
+class Strategy:
+    """Base: DOWNPOUR-family behavior (pull, local tx steps, delta commit)."""
+
+    name = "base"
+    #: True when the local replica is reset to the fresh center after a
+    #: commit (DOWNPOUR family); EASGD family keeps its replica.
+    resets_to_center = True
+    #: False for strategies that never exchange (Independent) — lets the
+    #: substrate skip the per-round psum + center update entirely.
+    exchanges = True
+
+    def init_carry(self, params, tx: optax.GradientTransformation) -> Carry:
+        return Carry(params=params, opt_state=tx.init(params), extra=())
+
+    def round_start(self, carry: Carry, center) -> Carry:
+        """Pull: DOWNPOUR family starts each round from the center."""
+        return carry._replace(params=center)
+
+    def local_step(self, grad_fn, tx, carry: Carry, batch,
+                   rngs=None) -> Tuple[Carry, dict]:
+        """One minibatch step with the worker optimizer."""
+        (loss, logits), grads = grad_fn(carry.params, batch, rngs)
+        updates, opt_state = tx.update(grads, carry.opt_state, carry.params)
+        params = optax.apply_updates(carry.params, updates)
+        return (carry._replace(params=params, opt_state=opt_state),
+                {"loss": loss, "logits": logits})
+
+    def commit(self, carry: Carry, center, window: int):
+        """What gets sent to the server: accumulated delta."""
+        return tree_sub(carry.params, center)
+
+    def staleness_weight(self, position):
+        """Server-side scale for a commit applied at schedule position
+        ``position`` (0 = first/freshest)."""
+        return jnp.asarray(1.0, jnp.float32)
+
+    def post_commit(self, carry: Carry, commit, new_center) -> Carry:
+        """After the exchange: DOWNPOUR family pulls the fresh center."""
+        if self.resets_to_center:
+            return carry._replace(params=new_center)
+        return carry
+
+
+class Downpour(Strategy):
+    """DOWNPOUR (Dean et al. 2012): windowed delta push, fresh-center pull."""
+
+    name = "downpour"
+
+
+class ADAG(Strategy):
+    """ADAG: DOWNPOUR with accumulated-gradient normalization — the commit is
+    divided by the window so the server step is λ-invariant (NUMERICS.md)."""
+
+    name = "adag"
+
+    def commit(self, carry: Carry, center, window: int):
+        return tree_scale(tree_sub(carry.params, center), 1.0 / window)
+
+
+class DynSGD(Strategy):
+    """DynSGD: DOWNPOUR deltas, server scales each by 1/(staleness+1)."""
+
+    name = "dynsgd"
+
+    def staleness_weight(self, position):
+        return 1.0 / (position.astype(jnp.float32) + 1.0)
+
+
+class AEASGD(Strategy):
+    """Asynchronous EASGD (Zhang et al. 2015): persistent local replicas with
+    symmetric elastic attraction E = ρ·η·(w − c)."""
+
+    name = "aeasgd"
+    resets_to_center = False
+
+    def __init__(self, rho: float, learning_rate: float):
+        self.rho = float(rho)
+        self.learning_rate = float(learning_rate)
+
+    def round_start(self, carry: Carry, center) -> Carry:
+        return carry  # replica persists; center only read at commit time
+
+    def commit(self, carry: Carry, center, window: int):
+        alpha = self.rho * self.learning_rate
+        return tree_scale(tree_sub(carry.params, center), alpha)
+
+    def post_commit(self, carry: Carry, commit, new_center) -> Carry:
+        # worker side of the elastic update: w ← w − E
+        return carry._replace(params=tree_sub(carry.params, commit))
+
+
+class EAMSGD(AEASGD):
+    """EAMSGD: AEASGD with explicit Nesterov momentum on the local replica
+    (v ← μv − η∇f(w + μv); w ← w + v). The worker-optimizer kwarg is ignored
+    by design — momentum lives in the worker loop, as in the reference."""
+
+    name = "eamsgd"
+
+    def __init__(self, rho: float, learning_rate: float, momentum: float):
+        super().__init__(rho, learning_rate)
+        self.momentum = float(momentum)
+
+    def init_carry(self, params, tx) -> Carry:
+        return Carry(params=params, opt_state=(),
+                     extra=tree_zeros_like(params))
+
+    def local_step(self, grad_fn, tx, carry: Carry, batch,
+                   rngs=None) -> Tuple[Carry, dict]:
+        mu, eta = self.momentum, self.learning_rate
+        v = carry.extra
+        lookahead = jax.tree.map(lambda w, vi: w + mu * vi, carry.params, v)
+        (loss, logits), grads = grad_fn(lookahead, batch, rngs)
+        v = jax.tree.map(lambda vi, g: mu * vi - eta * g, v, grads)
+        params = tree_add(carry.params, v)
+        return (carry._replace(params=params, extra=v),
+                {"loss": loss, "logits": logits})
+
+
+class Independent(Strategy):
+    """No exchange at all: replicas train in isolation (AveragingTrainer /
+    EnsembleTrainer substrate). Commits are zero so the center never moves;
+    the trainer reads the per-worker replicas at the end (mean for
+    Averaging, all of them for Ensemble)."""
+
+    name = "independent"
+    resets_to_center = False
+    exchanges = False
+
+    def round_start(self, carry: Carry, center) -> Carry:
+        return carry
+
+    def commit(self, carry: Carry, center, window: int):
+        return tree_zeros_like(carry.params)
+
+
+def get(name: str, *, learning_rate: float = 0.01, **kwargs) -> Strategy:
+    """Resolve a strategy by trainer name. Rejects hyperparameters the
+    selected strategy doesn't take — a misdirected rho/momentum should fail
+    loudly, not be silently dropped."""
+    name = name.lower()
+
+    def _done():
+        if kwargs:
+            raise TypeError(
+                f"Strategy {name!r} does not take {sorted(kwargs)}")
+
+    if name == "downpour":
+        _done()
+        return Downpour()
+    if name == "adag":
+        _done()
+        return ADAG()
+    if name == "dynsgd":
+        _done()
+        return DynSGD()
+    if name == "aeasgd":
+        rho = kwargs.pop("rho", 5.0)
+        _done()
+        return AEASGD(rho, learning_rate)
+    if name == "eamsgd":
+        rho = kwargs.pop("rho", 5.0)
+        momentum = kwargs.pop("momentum", 0.9)
+        _done()
+        return EAMSGD(rho, learning_rate, momentum)
+    if name == "independent":
+        _done()
+        return Independent()
+    raise ValueError(f"Unknown strategy {name!r}")

@@ -1,0 +1,157 @@
+"""The parallel substrate: strategies lifted onto a device mesh.
+
+This module is the load-bearing design swap of the whole framework
+(SURVEY.md §5 "Distributed communication backend"): where the reference runs
+a socket parameter server on the driver and workers commit/pull pickled
+deltas over TCP (``distkeras/networking.py``/``parameter_servers.py`` —
+unverified, mount empty), here the center variable is device-resident
+replicated state and every round's commits are folded with ONE staleness-
+weighted ``psum`` over the ``workers`` mesh axis, inside a single jitted
+computation. An epoch is `lax.scan(rounds) ∘ lax.scan(window)` — no Python in
+the hot loop, no host round-trips, collectives ride ICI.
+
+Asynchrony is emulated deterministically: each worker's commit is assigned a
+schedule position per round (rotating by default), and staleness-aware
+strategies (DynSGD) weight commits by that position. See NUMERICS.md and
+DESIGN.md for why determinism-by-construction replaces TCP-timing accidents.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu import engine
+from distkeras_tpu.parallel import mesh as mesh_lib
+from distkeras_tpu.parallel.strategies import Carry, Strategy
+from distkeras_tpu.utils.trees import tree_add, tree_scale
+
+WORKERS = mesh_lib.WORKER_AXIS
+
+
+def build_epoch_fn(model, loss, tx: optax.GradientTransformation,
+                   strategy: Strategy, mesh: Mesh, num_workers: int,
+                   window: int, metrics: Sequence[str] = (),
+                   dropout_seed: int = 0) -> Callable:
+    """Compile the per-epoch distributed training function.
+
+    Returns ``epoch_fn(center, carries, data, round_offset) ->
+    (center, carries, metrics)`` where
+
+    - ``center``: replicated params pytree (the parameter server state),
+    - ``carries``: per-worker Carry pytree with leading ``num_workers`` axis,
+    - ``data``: dict of arrays shaped (num_workers, rounds, window, batch, ...),
+    - ``round_offset``: int32 scalar, global round counter (continues the
+      staleness rotation across epochs),
+    - ``metrics``: dict of (num_workers, rounds, window) float arrays plus
+      per-round ``staleness`` (num_workers, rounds).
+    """
+    grad_fn = engine.make_grad_fn(model, loss)
+    metric_names = tuple(metrics)
+    base_key = jax.random.key(dropout_seed)
+
+    def worker_epoch(center, carry, data, round_offset):
+        # Per-device blocks arrive with the leading workers axis of size 1.
+        carry = jax.tree.map(lambda x: x[0], carry)
+        data = jax.tree.map(lambda x: x[0], data)
+        k = jax.lax.axis_index(WORKERS)
+        num_rounds = jax.tree.leaves(data)[0].shape[0]
+
+        def one_round(state, xs):
+            center, carry = state
+            r_idx, batches = xs
+            carry = strategy.round_start(carry, center)
+
+            def one_step(c, step_xs):
+                batch, i = step_xs
+                rng = jax.random.fold_in(
+                    jax.random.fold_in(jax.random.fold_in(base_key, k), r_idx), i)
+                c, m = strategy.local_step(grad_fn, tx, c, batch,
+                                           rngs={"dropout": rng})
+                out = {"loss": m["loss"]}
+                for name in metric_names:
+                    out[name] = engine.compute_metric(
+                        name, m["logits"], batch["labels"])
+                return c, out
+
+            step_idx = jnp.arange(window, dtype=jnp.int32)
+            carry, step_ms = jax.lax.scan(one_step, carry, (batches, step_idx))
+            if strategy.exchanges:
+                commit = strategy.commit(carry, center, window)
+                position = (k + r_idx) % num_workers
+                weight = strategy.staleness_weight(position)
+                total = jax.lax.psum(tree_scale(commit, weight), WORKERS)
+                new_center = tree_add(center, total)
+                carry = strategy.post_commit(carry, commit, new_center)
+                step_ms["staleness"] = position.astype(jnp.float32)
+            else:
+                new_center = center
+                step_ms["staleness"] = jnp.float32(0.0)
+            return (new_center, carry), step_ms
+
+        rounds = round_offset + jnp.arange(num_rounds, dtype=jnp.int32)
+        (center, carry), ms = jax.lax.scan(one_round, (center, carry),
+                                           (rounds, data))
+        # Restore the size-1 workers axis for the sharded outputs.
+        carry = jax.tree.map(lambda x: x[None], carry)
+        ms = jax.tree.map(lambda x: x[None], ms)
+        return center, carry, ms
+
+    shmapped = jax.shard_map(
+        worker_epoch, mesh=mesh,
+        in_specs=(P(), P(WORKERS), P(WORKERS), P()),
+        out_specs=(P(), P(WORKERS), P(WORKERS)),
+        check_vma=False,
+    )
+    return jax.jit(shmapped, donate_argnums=(0, 1))
+
+
+def init_center_and_carries(params, tx, strategy: Strategy, mesh: Mesh,
+                            num_workers: int) -> Tuple[Any, Any]:
+    """Place the center (replicated) and per-worker carries (sharded).
+
+    All replicas start from the center — the reference's model broadcast.
+    """
+    center = mesh_lib.put_replicated(params, mesh)
+    carry = strategy.init_carry(params, tx)
+    stacked = jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (num_workers,) + jnp.shape(x)),
+        carry)
+    carries = mesh_lib.put_worker_sharded(stacked, mesh)
+    return center, carries
+
+
+def stage_epoch_data(shards, features_col: str, label_col: str,
+                     batch_size: int, window: int, mesh: Mesh,
+                     min_rounds: Optional[int] = None):
+    """Host-side data staging: per-worker shards -> one sharded device array
+    shaped (workers, rounds, window, batch, ...).
+
+    Every worker gets the same round count (static shapes — XLA's contract);
+    the common count is the smallest shard's, surplus rows are dropped (the
+    reference's analogue: Spark partitions simply finish at different times).
+    """
+    per_round = batch_size * window
+    rounds = min(len(s) // per_round for s in shards)
+    if min_rounds is not None:
+        rounds = min(rounds, min_rounds)
+    if rounds == 0:
+        raise ValueError(
+            f"Shards of sizes {[len(s) for s in shards]} cannot form a "
+            f"single round of window={window} x batch={batch_size}")
+    n = rounds * per_round
+
+    def stack(col):
+        arrs = [np.asarray(s[col][:n]).reshape(
+            (rounds, window, batch_size) + np.asarray(s[col]).shape[1:])
+            for s in shards]
+        return np.stack(arrs)
+
+    data = {"features": stack(features_col), "labels": stack(label_col)}
+    return jax.device_put(data, mesh_lib.worker_sharded(mesh)), rounds

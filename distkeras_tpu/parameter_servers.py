@@ -1,0 +1,99 @@
+"""Parameter servers — device-resident center state, reference-shaped API.
+
+Reference parity: ``distkeras/parameter_servers.py`` (unverified, mount
+empty) runs a socket server on the Spark driver: ``handle_commit`` folds a
+pickled delta into the center variable under a lock, ``handle_pull`` sends
+the center back. Two facts about that design drove this rewrite:
+
+- the center lived in driver RAM and every exchange crossed TCP;
+- concurrency safety was one ``threading.Lock``.
+
+Here the center variable is a JAX pytree resident on device (replicated over
+the mesh), commits are jitted folds, and the "lock" is XLA's program order.
+The fast path (the trainer zoo) never touches this class — it folds commits
+with an in-graph ``psum`` (see parallel/substrate.py). This module exists for
+
+1. API parity: the same commit/pull vocabulary, usable interactively;
+2. the host-driven TRUE-async mode (threads pushing at real wall-clock
+   times, distkeras_tpu/parallel/host_async.py) where a live mutable center
+   is the whole point;
+3. golden tests that emulate the reference's sequential commit application.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.utils.trees import tree_add, tree_scale
+
+
+class ParameterServer:
+    """Base: holds the center variable and an update counter."""
+
+    def __init__(self, params: Any):
+        self.center_variable = params
+        self.num_updates = 0
+        self._lock = threading.Lock()
+
+    def initialize(self, params: Any) -> None:
+        with self._lock:
+            self.center_variable = params
+            self.num_updates = 0
+
+    # pull: returns the center and the server clock (DynSGD needs the clock
+    # to compute staleness at its next commit).
+    def pull(self):
+        with self._lock:
+            return self.center_variable, self.num_updates
+
+    def commit(self, delta: Any, last_update: int = 0) -> None:
+        raise NotImplementedError
+
+    # reference lifecycle names (no socket to start/stop, kept as no-ops so
+    # ported driver scripts keep working)
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+
+@jax.jit
+def _fold(center, delta, weight):
+    return tree_add(center, tree_scale(delta, weight))
+
+
+class DeltaParameterServer(ParameterServer):
+    """center += delta (DOWNPOUR/ADAG/(A)EASGD server rule; ADAG's window
+    normalization happens worker-side, see NUMERICS.md)."""
+
+    def commit(self, delta: Any, last_update: int = 0) -> None:
+        with self._lock:
+            self.center_variable = _fold(self.center_variable, delta,
+                                         jnp.float32(1.0))
+            self.num_updates += 1
+
+
+# The reference gives ADAG its own server class; the fold is identical to
+# DeltaParameterServer (the normalization is in the worker's commit).
+ADAGParameterServer = DeltaParameterServer
+
+
+class DynSGDParameterServer(ParameterServer):
+    """center += delta / (staleness + 1), staleness = server clock at commit
+    minus server clock at the committer's last pull."""
+
+    def commit(self, delta: Any, last_update: int = 0) -> None:
+        with self._lock:
+            staleness = self.num_updates - int(last_update)
+            if staleness < 0:
+                raise ValueError(
+                    f"last_update {last_update} is ahead of the server clock "
+                    f"{self.num_updates}")
+            self.center_variable = _fold(self.center_variable, delta,
+                                         jnp.float32(1.0 / (staleness + 1)))
+            self.num_updates += 1

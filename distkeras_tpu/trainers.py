@@ -106,6 +106,189 @@ class Trainer:
         raise NotImplementedError
 
 
+class DistributedTrainer(Trainer):
+    """Base for every multi-replica trainer.
+
+    Reference parity (``DistributedTrainer(num_workers, batch_size,
+    features_col, label_col, num_epoch, master_port)``): same kwargs, but a
+    "worker" is a mesh-axis replica instead of a Spark executor, and there is
+    no master_port — the parameter server is device-resident state folded
+    with collectives (the kwarg is accepted and ignored so reference driver
+    scripts port cleanly).
+
+    ``strategy_name`` selects the update algebra (see
+    parallel/strategies.py + NUMERICS.md).
+    """
+
+    strategy_name: str = "downpour"
+
+    def __init__(self, model, loss="categorical_crossentropy",
+                 worker_optimizer="sgd", learning_rate: float = 0.01,
+                 metrics=("accuracy",), features_col="features",
+                 label_col="label", batch_size: int = 32, num_epoch: int = 1,
+                 num_workers: Optional[int] = None,
+                 communication_window: int = 5,
+                 master_port: Optional[int] = None,  # parity no-op
+                 mesh=None, seed: int = 0, **strategy_kwargs):
+        super().__init__(model, loss, worker_optimizer, learning_rate,
+                         metrics, features_col, label_col, batch_size,
+                         num_epoch, seed)
+        from distkeras_tpu.parallel import mesh as mesh_lib
+
+        self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(num_workers)
+        self.num_workers = self.mesh.shape[mesh_lib.WORKER_AXIS]
+        self.communication_window = int(communication_window)
+        self.strategy = self._make_strategy(**strategy_kwargs)
+        self.num_updates = 0
+        self.staleness_history: list[float] = []
+
+    def _make_strategy(self, **kw):
+        from distkeras_tpu.parallel import strategies
+
+        return strategies.get(self.strategy_name,
+                              learning_rate=self.learning_rate, **kw)
+
+    def _init_carries(self, center_params):
+        from distkeras_tpu.parallel import substrate
+
+        return substrate.init_center_and_carries(
+            center_params, self.tx, self.strategy, self.mesh, self.num_workers)
+
+    def _record(self, ms: dict, rounds: int):
+        """Flatten (workers, rounds, window) metrics into worker-averaged
+        per-step history + staleness bookkeeping."""
+        stal = ms.pop("staleness")  # (workers, rounds)
+        self.staleness_history.extend(
+            float(s) for s in stal.mean(axis=0).reshape(-1))
+        w, r, win = ms["loss"].shape
+        for ri in range(r):
+            for si in range(win):
+                self.history.append(
+                    {k: float(v[:, ri, si].mean()) for k, v in ms.items()})
+        self.num_updates += rounds * self.num_workers
+
+    def train(self, dataset: Dataset, shuffle: bool = False):
+        from distkeras_tpu.parallel import substrate
+
+        self._start()
+        self._check_trainable(
+            dataset, self.batch_size * self.communication_window * self.num_workers)
+        state = self._init_params(dataset)
+        center, carries = self._init_carries(state.params)
+        epoch_fn = substrate.build_epoch_fn(
+            self.model, self.loss, self.tx, self.strategy, self.mesh,
+            self.num_workers, self.communication_window, self.metrics,
+            dropout_seed=self.seed)
+        self.history = []
+        self.staleness_history = []
+        self.num_updates = 0
+        round_offset = 0
+        for epoch in range(self.num_epoch):
+            ds = dataset.shuffle(self.seed + epoch) if shuffle else dataset
+            shards = ds.repartition(self.num_workers)
+            data, rounds = substrate.stage_epoch_data(
+                shards, self.features_col, self.label_col, self.batch_size,
+                self.communication_window, self.mesh)
+            center, carries, ms = epoch_fn(center, carries, data,
+                                           np.int32(round_offset))
+            round_offset += rounds
+            self._record(jax.device_get(ms), rounds)
+        self.params = self._finalize(center, carries)
+        self._stop()
+        return self.params
+
+    def _finalize(self, center, carries):
+        """Async trainers return the parameter server's center variable."""
+        return jax.device_get(center)
+
+
+class DOWNPOUR(DistributedTrainer):
+    """Async data-parallel SGD with windowed delta push/pull (NUMERICS.md)."""
+
+    strategy_name = "downpour"
+
+
+class ADAG(DistributedTrainer):
+    """DOWNPOUR with accumulated-gradient normalization — the reference's
+    flagship algorithm (NUMERICS.md)."""
+
+    strategy_name = "adag"
+
+
+class DynSGD(DistributedTrainer):
+    """Staleness-aware async SGD: commits scaled by 1/(staleness+1)."""
+
+    strategy_name = "dynsgd"
+
+
+class AEASGD(DistributedTrainer):
+    """Async elastic-averaging SGD. Extra kwargs: rho (elastic coefficient)."""
+
+    strategy_name = "aeasgd"
+
+    def __init__(self, model, rho: float = 5.0, **kw):
+        super().__init__(model, rho=rho, **kw)
+
+
+class EAMSGD(DistributedTrainer):
+    """Elastic averaging with Nesterov momentum on the local replicas.
+    Extra kwargs: rho, momentum."""
+
+    strategy_name = "eamsgd"
+
+    def __init__(self, model, rho: float = 5.0, momentum: float = 0.9, **kw):
+        super().__init__(model, rho=rho, momentum=momentum, **kw)
+
+
+class AveragingTrainer(DistributedTrainer):
+    """Train K isolated replicas on K shards, return the arithmetic mean of
+    their weights (reference AveragingTrainer semantics)."""
+
+    strategy_name = "independent"
+
+    def _finalize(self, center, carries):
+        from distkeras_tpu.utils.trees import tree_scale
+
+        summed = jax.jit(
+            lambda c: jax.tree.map(lambda x: x.sum(axis=0), c))(carries.params)
+        return jax.device_get(tree_scale(summed, 1.0 / self.num_workers))
+
+
+class EnsembleTrainer(DistributedTrainer):
+    """Train K isolated models, return all K param sets (list). Each replica
+    gets a distinct init (seed + worker index) and its own data shard."""
+
+    strategy_name = "independent"
+
+    def _init_carries(self, center_params):
+        from distkeras_tpu.parallel import mesh as mesh_lib
+        from distkeras_tpu.parallel import substrate
+
+        del center_params
+        keys = jax.random.split(jax.random.key(self.seed), self.num_workers)
+        sample = {"features": np.zeros((1,) + self._feature_shape, np.float32)}
+
+        def init_one(k):
+            variables = self.model.init(k, sample["features"], train=False)
+            return self.strategy.init_carry(variables["params"], self.tx)
+
+        stacked = jax.vmap(init_one)(keys)
+        carries = mesh_lib.put_worker_sharded(stacked, self.mesh)
+        center = mesh_lib.put_replicated(
+            jax.tree.map(lambda x: x[0], jax.device_get(stacked.params)),
+            self.mesh)
+        return center, carries
+
+    def train(self, dataset: Dataset, shuffle: bool = False):
+        self._feature_shape = np.asarray(dataset[self.features_col][0]).shape
+        return super().train(dataset, shuffle)
+
+    def _finalize(self, center, carries):
+        host = jax.device_get(carries.params)
+        return [jax.tree.map(lambda x, i=i: x[i], host)
+                for i in range(self.num_workers)]
+
+
 class SingleTrainer(Trainer):
     """One replica, plain minibatch SGD — the reference's minimum slice
     (SingleTrainer: coalesce to one partition, train locally)."""

@@ -5,12 +5,15 @@ only multi-worker-without-a-cluster story, per SURVEY.md §4): N XLA host
 devices stand in for N TPU chips so every sharding/collective path compiles
 and executes without hardware.
 
-Must run before any jax import, hence the env mutation at module scope.
+Platform forcing is belt-and-braces: this machine's sitecustomize registers
+the axon TPU backend and overrides JAX_PLATFORMS from the environment, so the
+env var alone is NOT enough — jax.config.update after import is what sticks
+(must happen before the first backend init).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -18,11 +21,18 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
+
+
+def pytest_sessionstart(session):
+    devs = jax.devices()
+    assert devs[0].platform == "cpu", f"tests must run on CPU, got {devs}"
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
 
 
 @pytest.fixture(scope="session")
 def devices():
-    devs = jax.devices()
-    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
-    return devs
+    return jax.devices()

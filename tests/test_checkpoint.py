@@ -1,0 +1,50 @@
+import jax
+import numpy as np
+import optax
+import pytest
+
+from distkeras_tpu import engine
+from distkeras_tpu.checkpoint import Checkpointer, load_params, save_params
+from distkeras_tpu.models.mlp import MLP
+
+
+@pytest.fixture
+def state():
+    model = MLP(features=(8,), num_classes=3)
+    batch = {"features": np.zeros((2, 12), np.float32)}
+    return engine.create_train_state(model, jax.random.key(0), batch,
+                                     optax.adam(1e-3))
+
+
+def test_save_restore_roundtrip(tmp_path, state):
+    ckpt = Checkpointer(str(tmp_path / "ckpt"))
+    ckpt.save(0, state, wait=True)
+    ckpt.save(5, state, wait=True)
+    assert ckpt.latest_step() == 5
+    restored = ckpt.restore(like=state)
+    jax.tree.map(np.testing.assert_array_equal, state.params, restored.params)
+    jax.tree.map(np.testing.assert_array_equal, state.opt_state,
+                 restored.opt_state)
+    ckpt.close()
+
+
+def test_retention(tmp_path, state):
+    ckpt = Checkpointer(str(tmp_path / "ckpt"), max_to_keep=2)
+    for s in (1, 2, 3, 4):
+        ckpt.save(s, state, wait=True)
+    assert ckpt.all_steps() == [3, 4]
+    ckpt.close()
+
+
+def test_restore_missing_raises(tmp_path, state):
+    ckpt = Checkpointer(str(tmp_path / "empty"))
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore(like=state)
+    ckpt.close()
+
+
+def test_params_file_roundtrip(tmp_path, state):
+    path = str(tmp_path / "params.npz")
+    save_params(path, state.params)
+    restored = load_params(path, like=state.params)
+    jax.tree.map(np.testing.assert_array_equal, state.params, restored)

@@ -1,0 +1,123 @@
+"""End-to-end tests of the distributed trainer zoo on the 8-device CPU mesh
+(the Spark local[N] analogue, SURVEY.md §4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from distkeras_tpu import (
+    ADAG,
+    AEASGD,
+    DOWNPOUR,
+    AveragingTrainer,
+    DynSGD,
+    EAMSGD,
+    EnsembleTrainer,
+)
+from distkeras_tpu.data.dataset import Dataset, synthetic_mnist
+from distkeras_tpu.models.mlp import MLP
+
+
+def _model():
+    return MLP(features=(32,), num_classes=10)
+
+
+COMMON = dict(loss="categorical_crossentropy", learning_rate=0.05,
+              batch_size=32, num_epoch=2, num_workers=8,
+              communication_window=2)
+
+
+@pytest.mark.parametrize("cls,extra", [
+    (DOWNPOUR, {}),
+    (ADAG, {}),
+    (DynSGD, {}),
+    (AEASGD, {"rho": 1.0}),
+    (EAMSGD, {"rho": 1.0, "momentum": 0.9}),
+])
+def test_async_trainer_converges(cls, extra):
+    ds = synthetic_mnist(n=4096, seed=0)
+    t = cls(_model(), **COMMON, **extra)
+    params = t.train(ds, shuffle=True)
+    hist = t.get_history()
+    assert len(hist) > 0
+    early = np.mean([h["loss"] for h in hist[:4]])
+    late = np.mean([h["loss"] for h in hist[-4:]])
+    assert late < early, f"{cls.__name__}: {early} -> {late}"
+    assert np.isfinite(late)
+    assert params is not None
+    assert t.num_updates > 0
+    assert len(t.staleness_history) > 0
+    assert "accuracy" in hist[0]
+
+
+def test_dynsgd_staleness_rotates():
+    ds = synthetic_mnist(n=2048, seed=1)
+    t = DynSGD(_model(), **COMMON)
+    t.train(ds)
+    # mean staleness over a full rotation is (K-1)/2 for every round
+    assert np.allclose(t.staleness_history, 3.5)
+
+
+def test_averaging_trainer_identical_shards_equals_single():
+    """NUMERICS invariant 6: identical shards -> mean == each replica."""
+    block = synthetic_mnist(n=128, seed=2)
+    tiled = Dataset.concat([block] * 8)
+    kw = dict(loss="categorical_crossentropy", learning_rate=0.05,
+              batch_size=32, num_epoch=1, metrics=())
+    avg = AveragingTrainer(_model(), num_workers=8, communication_window=1,
+                           **kw)
+    p_avg = avg.train(tiled)
+    from distkeras_tpu.trainers import SingleTrainer
+    single = SingleTrainer(_model(), **kw)
+    p_single = single.train(block)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+        p_avg, p_single)
+
+
+def test_ensemble_trainer_returns_k_distinct_models():
+    ds = synthetic_mnist(n=2048, seed=3)
+    t = EnsembleTrainer(_model(), **COMMON)
+    models = t.train(ds)
+    assert isinstance(models, list) and len(models) == 8
+    k0 = np.asarray(models[0]["dense_0"]["kernel"])
+    k1 = np.asarray(models[1]["dense_0"]["kernel"])
+    assert not np.allclose(k0, k1)  # distinct inits + shards
+
+
+def test_distributed_dataset_too_small_raises():
+    ds = synthetic_mnist(n=100, seed=0)
+    t = DOWNPOUR(_model(), **COMMON)
+    with pytest.raises(ValueError):
+        t.train(ds)
+
+
+def test_master_port_kwarg_is_accepted():
+    # drop-in parity: reference scripts pass master_port
+    t = DOWNPOUR(_model(), master_port=5000, num_workers=2)
+    assert t.num_workers == 2
+
+
+def test_distributed_dropout_model_trains():
+    ds = synthetic_mnist(n=2048, seed=4)
+    t = DOWNPOUR(MLP(features=(32,), num_classes=10, dropout_rate=0.3),
+                 **COMMON)
+    t.train(ds)
+    assert np.isfinite(t.get_history()[-1]["loss"])
+
+
+def test_misdirected_strategy_kwargs_rejected():
+    with pytest.raises(TypeError):
+        DOWNPOUR(_model(), num_workers=2, rho=2.0)
+    with pytest.raises(TypeError):
+        AEASGD(_model(), num_workers=2, momentum=0.5)
+
+
+def test_retrain_resets_bookkeeping():
+    ds = synthetic_mnist(n=2048, seed=5)
+    t = DOWNPOUR(_model(), **COMMON)
+    t.train(ds)
+    first = (len(t.get_history()), t.num_updates, len(t.staleness_history))
+    t.train(ds)
+    second = (len(t.get_history()), t.num_updates, len(t.staleness_history))
+    assert first == second

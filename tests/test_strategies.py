@@ -1,0 +1,153 @@
+"""Golden tests for the async update algebra — the invariants pinned in
+NUMERICS.md. The reference had no tests for these rules at all (SURVEY.md
+§4); these are the contract the substrate must preserve."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distkeras_tpu import engine
+from distkeras_tpu.data.dataset import Dataset, synthetic_mnist
+from distkeras_tpu.models.mlp import MLP
+from distkeras_tpu.parallel import strategies
+from distkeras_tpu.parameter_servers import (
+    ADAGParameterServer,
+    DeltaParameterServer,
+    DynSGDParameterServer,
+)
+from distkeras_tpu.trainers import DOWNPOUR, SingleTrainer
+from distkeras_tpu.utils.trees import tree_sub, tree_zeros_like
+
+
+def _tiny_setup(lr=0.05):
+    model = MLP(features=(16,), num_classes=4)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 12)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+    batch = {"features": x, "labels": y}
+    tx = optax.sgd(lr)
+    state = engine.create_train_state(model, jax.random.key(0), batch, tx)
+    grad_fn = engine.make_grad_fn(model, "categorical_crossentropy")
+    return model, tx, state, grad_fn, batch
+
+
+def test_invariant_1_downpour_k1_w1_equals_sequential_sgd():
+    """NUMERICS invariant 1: one worker, window 1 == plain SGD."""
+    ds = synthetic_mnist(n=512, seed=0)
+    kw = dict(loss="categorical_crossentropy", worker_optimizer="sgd",
+              learning_rate=0.05, batch_size=64, num_epoch=2, metrics=())
+    single = SingleTrainer(MLP(features=(16,), num_classes=10), **kw)
+    p_single = single.train(ds)
+    down = DOWNPOUR(MLP(features=(16,), num_classes=10), num_workers=1,
+                    communication_window=1, **kw)
+    p_down = down.train(ds)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        p_single, p_down)
+    np.testing.assert_allclose(
+        [h["loss"] for h in single.get_history()],
+        [h["loss"] for h in down.get_history()], rtol=1e-4)
+
+
+def test_invariant_2_adag_commit_is_downpour_over_window():
+    _, tx, state, grad_fn, batch = _tiny_setup()
+    down, adag = strategies.Downpour(), strategies.ADAG()
+    carry = down.init_carry(state.params, tx)
+    center = state.params
+    carry = down.round_start(carry, center)
+    for _ in range(4):
+        carry, _ = down.local_step(grad_fn, tx, carry, batch)
+    c_down = down.commit(carry, center, window=4)
+    c_adag = adag.commit(carry, center, window=4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a) / 4.0, np.asarray(b), rtol=1e-6),
+        c_down, c_adag)
+
+
+def test_invariant_3_dynsgd_weight_zero_staleness_is_one():
+    dyn = strategies.DynSGD()
+    assert float(dyn.staleness_weight(jnp.int32(0))) == 1.0
+    assert float(dyn.staleness_weight(jnp.int32(3))) == pytest.approx(0.25)
+    down = strategies.Downpour()
+    assert float(down.staleness_weight(jnp.int32(7))) == 1.0
+
+
+def test_invariant_4_aeasgd_fixed_point():
+    _, tx, state, grad_fn, _ = _tiny_setup()
+    strat = strategies.AEASGD(rho=1.0, learning_rate=0.05)
+    carry = strat.init_carry(state.params, tx)
+    commit = strat.commit(carry, state.params, window=4)  # w == c
+    for leaf in jax.tree.leaves(commit):
+        np.testing.assert_array_equal(np.asarray(leaf), 0.0)
+
+
+def test_invariant_5_eamsgd_mu0_step_equals_sgd_step():
+    _, tx, state, grad_fn, batch = _tiny_setup(lr=0.05)
+    eam = strategies.EAMSGD(rho=1.0, learning_rate=0.05, momentum=0.0)
+    ca = eam.init_carry(state.params, tx)
+    ca, _ = eam.local_step(grad_fn, tx, ca, batch)
+    sgd = strategies.AEASGD(rho=1.0, learning_rate=0.05)
+    cb = sgd.init_carry(state.params, tx)
+    cb, _ = sgd.local_step(grad_fn, tx, cb, batch)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7),
+        ca.params, cb.params)
+
+
+def test_elastic_symmetry_worker_and_server_move_oppositely():
+    """EASGD's exchange conserves w - c displacement: server gains what the
+    worker sheds."""
+    _, tx, state, grad_fn, batch = _tiny_setup()
+    strat = strategies.AEASGD(rho=2.0, learning_rate=0.1)
+    carry = strat.init_carry(state.params, tx)
+    for _ in range(3):
+        carry, _ = strat.local_step(grad_fn, tx, carry, batch)
+    center = state.params
+    commit = strat.commit(carry, center, window=3)
+    alpha = 2.0 * 0.1
+    expected = jax.tree.map(lambda w, c: alpha * (w - c), carry.params, center)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-3,
+                                                         atol=1e-7),
+                 commit, expected)
+    after = strat.post_commit(carry, commit, center)
+    moved = tree_sub(carry.params, after.params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-3,
+                                                         atol=1e-7),
+                 moved, commit)
+
+
+def test_independent_strategy_never_moves_center():
+    _, tx, state, grad_fn, batch = _tiny_setup()
+    strat = strategies.Independent()
+    carry = strat.init_carry(state.params, tx)
+    carry, _ = strat.local_step(grad_fn, tx, carry, batch)
+    commit = strat.commit(carry, state.params, window=1)
+    for leaf in jax.tree.leaves(commit):
+        np.testing.assert_array_equal(np.asarray(leaf), 0.0)
+
+
+# -- parameter server emulation (reference PS semantics) --------------------
+
+def test_delta_ps_accumulates():
+    ps = DeltaParameterServer({"w": jnp.zeros(3)})
+    ps.commit({"w": jnp.ones(3)})
+    ps.commit({"w": jnp.ones(3) * 2})
+    center, clock = ps.pull()
+    np.testing.assert_allclose(np.asarray(center["w"]), 3.0)
+    assert clock == 2
+    assert ADAGParameterServer is DeltaParameterServer
+
+
+def test_dynsgd_ps_staleness_scaling():
+    ps = DynSGDParameterServer({"w": jnp.zeros(())})
+    ps.commit({"w": jnp.ones(())}, last_update=0)   # staleness 0 -> +1
+    ps.commit({"w": jnp.ones(())}, last_update=0)   # staleness 1 -> +1/2
+    ps.commit({"w": jnp.ones(())}, last_update=2)   # staleness 0 -> +1
+    center, clock = ps.pull()
+    assert clock == 3
+    np.testing.assert_allclose(float(center["w"]), 2.5)
+    with pytest.raises(ValueError):
+        ps.commit({"w": jnp.ones(())}, last_update=99)
