@@ -1,0 +1,144 @@
+"""Benchmark: flagship distributed training step on real hardware.
+
+Runs the framework's actual distributed training machinery (substrate
+epoch_fn: shard_map'd scanned rounds + psum center fold, ADAG strategy) on
+ResNet-50 with synthetic ImageNet-shaped data, and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+
+The reference publishes no samples/sec numbers (BASELINE.md), so
+``vs_baseline`` is measured against the driver's north star instead: the
+throughput ResNet-50 would need on this chip to hit 50% MFU
+(vs_baseline = achieved_MFU / 0.50). >1.0 beats the north star.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def run(batch_size: int, image_side: int, window: int, rounds: int,
+        num_classes: int, tiny: bool):
+    from distkeras_tpu import engine, observability
+    from distkeras_tpu.models.resnet import ResNet, BasicBlock, resnet50
+    from distkeras_tpu.ops import optimizers as opt_lib
+    from distkeras_tpu.parallel import mesh as mesh_lib
+    from distkeras_tpu.parallel import strategies, substrate
+
+    mesh = mesh_lib.make_mesh(num_workers=1, devices=jax.devices()[:1])
+    if tiny:
+        model = ResNet(stage_sizes=(1, 1), block=BasicBlock, width=8,
+                       num_classes=num_classes, dtype=jnp.float32)
+    else:
+        model = resnet50(num_classes=num_classes)
+    tx = opt_lib.get("sgd", 0.05)
+    strategy = strategies.get("adag", learning_rate=0.05)
+
+    rng = jax.random.key(0)
+    sample = {"features": jnp.zeros((batch_size, image_side, image_side, 3),
+                                    jnp.float32)}
+    state = engine.create_train_state(model, rng, sample, tx)
+    center, carries = substrate.init_center_and_carries(
+        state.params, tx, strategy, mesh, 1)
+    epoch_fn = substrate.build_epoch_fn(
+        model, "categorical_crossentropy", tx, strategy, mesh,
+        num_workers=1, window=window, metrics=())
+
+    rng_np = np.random.default_rng(0)
+    feats = rng_np.standard_normal(
+        (1, rounds, window, batch_size, image_side, image_side, 3)
+    ).astype(np.float32)
+    labels = np.eye(num_classes, dtype=np.float32)[
+        rng_np.integers(0, num_classes, (1, rounds, window, batch_size))]
+    data = jax.device_put({"features": feats, "labels": labels},
+                          mesh_lib.worker_sharded(mesh))
+
+    # FLOPs of one epoch_fn call: analytic matmul/conv count from the jaxpr
+    # (XLA cost_analysis underreports on this backend — see observability).
+    flops_per_call = observability.count_flops(
+        lambda c, ca, d: epoch_fn(c, ca, d, np.int32(0)),
+        center, carries, data)
+
+    import time
+
+    def step(carry):
+        center, carries = carry
+        center, carries, ms = epoch_fn(center, carries, data, np.int32(0))
+        return (center, carries), ms
+
+    def sync(center, ms) -> float:
+        # On this machine's tunneled TPU platform, block_until_ready returns
+        # before execution finishes; an actual device->host fetch is the only
+        # reliable completion barrier (measured: blocking-only timing reports
+        # physically impossible >100% MFU). Fetch two scalars: one depending
+        # on the metrics, one on the final center state.
+        loss = float(np.asarray(ms["loss"]).mean())
+        float(np.asarray(jax.tree.leaves(center)[0]).ravel()[0])
+        return loss
+
+    # compile + settle
+    for _ in range(2):
+        (center, carries), ms = step((center, carries))
+        sync(center, ms)
+    timed_calls = 5 if not tiny else 2
+    times = []
+    for _ in range(timed_calls):
+        t0 = time.perf_counter()
+        (center, carries), ms = step((center, carries))
+        sync(center, ms)
+        times.append(time.perf_counter() - t0)
+    step_time = sorted(times)[len(times) // 2]  # median: robust to stragglers
+
+    samples_per_call = rounds * window * batch_size
+    sps = samples_per_call / step_time
+    mfu_val = None
+    if flops_per_call:
+        mfu_val = observability.mfu(flops_per_call, step_time, num_chips=1)
+    return sps, mfu_val
+
+
+def main():
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        configs = [dict(batch_size=128, image_side=224, window=8, rounds=2,
+                        num_classes=1000, tiny=False),
+                   dict(batch_size=64, image_side=224, window=8, rounds=2,
+                        num_classes=1000, tiny=False)]
+    else:
+        configs = [dict(batch_size=8, image_side=32, window=2, rounds=2,
+                        num_classes=10, tiny=True)]
+
+    sps = mfu_val = None
+    for cfg in configs:
+        for attempt in range(2):  # retry: the tunneled backend flakes rarely
+            try:
+                sps, mfu_val = run(**cfg)
+                break
+            except Exception as e:  # OOM -> fall through to smaller batch
+                print(f"# bench config {cfg} attempt {attempt} failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+        if sps is not None:
+            break
+    if sps is None:
+        print(json.dumps({"metric": "resnet50_adag_samples_per_sec_per_chip",
+                          "value": 0.0, "unit": "samples/sec/chip",
+                          "vs_baseline": 0.0}))
+        sys.exit(1)
+
+    vs_baseline = (mfu_val / 0.50) if mfu_val is not None else None
+    out = {"metric": "resnet50_adag_samples_per_sec_per_chip",
+           "value": round(float(sps), 2), "unit": "samples/sec/chip",
+           "vs_baseline": round(float(vs_baseline), 4)
+           if vs_baseline is not None else None}
+    if mfu_val is not None:
+        out["mfu"] = round(float(mfu_val), 4)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -10,6 +10,17 @@ instead of TCP+pickle. See SURVEY.md for the layer-by-layer mapping.
 __version__ = "0.1.0"
 
 from distkeras_tpu.data.dataset import Dataset, synthetic_mnist
+from distkeras_tpu.evaluators import AccuracyEvaluator, Evaluator, LossEvaluator
+from distkeras_tpu.predictors import ModelClassifier, ModelPredictor, Predictor
+from distkeras_tpu.transformers import (
+    DenseTransformer,
+    LabelIndexTransformer,
+    MinMaxTransformer,
+    OneHotTransformer,
+    Pipeline,
+    ReshapeTransformer,
+    Transformer,
+)
 from distkeras_tpu.trainers import (
     ADAG,
     AEASGD,
@@ -26,15 +37,28 @@ from distkeras_tpu.trainers import (
 __all__ = [
     "ADAG",
     "AEASGD",
+    "AccuracyEvaluator",
     "AveragingTrainer",
     "DOWNPOUR",
     "Dataset",
+    "DenseTransformer",
     "DistributedTrainer",
     "DynSGD",
     "EAMSGD",
     "EnsembleTrainer",
+    "Evaluator",
+    "LabelIndexTransformer",
+    "LossEvaluator",
+    "MinMaxTransformer",
+    "ModelClassifier",
+    "ModelPredictor",
+    "OneHotTransformer",
+    "Pipeline",
+    "Predictor",
+    "ReshapeTransformer",
     "SingleTrainer",
     "Trainer",
+    "Transformer",
     "synthetic_mnist",
     "__version__",
 ]

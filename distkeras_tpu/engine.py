@@ -68,14 +68,25 @@ def make_loss_fn(model, loss) -> Callable:
 
 
 def compute_metric(name: str, logits: jax.Array, labels: jax.Array) -> jax.Array:
-    """Keras-style training metrics over one batch."""
-    if name in ("accuracy", "acc", "categorical_accuracy"):
+    """Keras-style training metrics over one batch.
+
+    Integer-label accuracy ignores positions with label < 0 (the masked_lm
+    ignore convention) so 'accuracy' is meaningful for MLM training too;
+    'masked_accuracy' is an explicit alias.
+    """
+    if name in ("accuracy", "acc", "categorical_accuracy", "masked_accuracy"):
         pred = jnp.argmax(logits, axis=-1)
-        true = labels if labels.ndim == logits.ndim - 1 else jnp.argmax(labels, axis=-1)
+        if labels.ndim == logits.ndim - 1:  # integer labels
+            valid = labels >= 0
+            hit = jnp.where(valid, (pred == labels), False)
+            return jnp.sum(hit.astype(jnp.float32)) / jnp.maximum(
+                jnp.sum(valid.astype(jnp.float32)), 1.0)
+        true = jnp.argmax(labels, axis=-1)
         return jnp.mean((pred == true).astype(jnp.float32))
     if name == "loss":  # already reported separately
         raise ValueError("'loss' is always recorded; don't list it in metrics")
-    raise ValueError(f"Unknown metric {name!r}; supported: 'accuracy'")
+    raise ValueError(f"Unknown metric {name!r}; supported: 'accuracy', "
+                     "'masked_accuracy'")
 
 
 def make_train_step(model, loss, tx: optax.GradientTransformation,

@@ -1,0 +1,73 @@
+"""Evaluators — metric computation over scored datasets.
+
+Reference parity: ``distkeras/evaluators.py`` (unverified, mount empty):
+``Evaluator`` base + ``AccuracyEvaluator(prediction_col, label_col)``
+computing the fraction of rows where prediction == label via Spark RDD
+filter/count. Here it is one vectorized comparison.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+
+
+class Evaluator:
+    def evaluate(self, dataset: Dataset) -> float:
+        raise NotImplementedError
+
+
+class AccuracyEvaluator(Evaluator):
+    """Fraction of rows where the predicted index equals the label index.
+
+    Accepts either index columns or one-hot/score vectors on both sides
+    (argmax is applied to >=2-d columns), matching how the reference's
+    examples feed it after LabelIndexTransformer.
+    """
+
+    def __init__(self, prediction_col: str = "prediction",
+                 label_col: str = "label"):
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    @staticmethod
+    def _to_index(col: np.ndarray, threshold: float = 0.5) -> np.ndarray:
+        col = np.asarray(col)
+        if col.ndim >= 2 and col.shape[-1] > 1:
+            return col.argmax(axis=-1)
+        flat = col.reshape(len(col))
+        if np.issubdtype(flat.dtype, np.floating) and \
+                not np.all(flat == np.floor(flat)):
+            # raw binary scores: threshold in probability space (values
+            # outside [0,1] are logits; sigmoid(x) >= 0.5 <=> x >= 0)
+            if flat.min() < 0.0 or flat.max() > 1.0:
+                return (flat >= 0.0).astype(np.int64)
+            return (flat >= threshold).astype(np.int64)
+        return flat.astype(np.int64)
+
+    def evaluate(self, dataset: Dataset) -> float:
+        pred = self._to_index(dataset[self.prediction_col])
+        true = self._to_index(dataset[self.label_col])
+        return float(np.mean(pred == true))
+
+
+class LossEvaluator(Evaluator):
+    """Mean loss of a scored dataset (upgrade over the reference, which only
+    ships accuracy; loss names resolve through ops.losses)."""
+
+    def __init__(self, loss: str = "categorical_crossentropy",
+                 prediction_col: str = "prediction",
+                 label_col: str = "label"):
+        from distkeras_tpu.ops import losses as losses_lib
+
+        self.loss_fn = losses_lib.get(loss)
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def evaluate(self, dataset: Dataset) -> float:
+        import jax.numpy as jnp
+
+        logits = jnp.asarray(dataset[self.prediction_col])
+        labels = jnp.asarray(dataset[self.label_col])
+        return float(self.loss_fn(logits, labels))

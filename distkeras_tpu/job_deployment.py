@@ -1,0 +1,127 @@
+"""Job deployment — Job/Punchcard parity (reference job_deployment.py).
+
+The reference (unverified, mount empty; SURVEY.md §2 marks details
+low-confidence) packages a training job and submits it to a remote head node,
+polling for results. The TPU-native story: a ``Job`` is a declarative spec
+(trainer class + kwargs + data source) that can run in-process or be handed
+to whatever launcher owns the TPU slice; a ``Punchcard`` is a JSON file
+holding a queue of such specs, executed in order.
+
+No SSH is implemented (zero-egress environments; launchers own placement
+now) — ``Job.run`` executes locally against the visible devices, which on a
+pod IS the distributed run once ``parallel.distributed.initialize`` has been
+called by the launcher.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Callable, Optional
+
+from distkeras_tpu.data.dataset import Dataset
+
+_TRAINER_REGISTRY: Optional[dict] = None
+
+
+def _trainers() -> dict:
+    global _TRAINER_REGISTRY
+    if _TRAINER_REGISTRY is None:
+        from distkeras_tpu import trainers as t
+
+        _TRAINER_REGISTRY = {
+            name: getattr(t, name)
+            for name in ("SingleTrainer", "AveragingTrainer",
+                         "EnsembleTrainer", "DOWNPOUR", "ADAG", "DynSGD",
+                         "AEASGD", "EAMSGD")
+        }
+    return _TRAINER_REGISTRY
+
+
+class Job:
+    """One training job: trainer name + kwargs + a data provider.
+
+    ``data`` may be a Dataset or a zero-arg callable returning one (so
+    punchcard JSON can name a loader by dotted path).
+    """
+
+    def __init__(self, job_name: str, trainer: str, model,
+                 data, num_epoch: int = 1, shuffle: bool = False,
+                 **trainer_kwargs):
+        self.job_name = job_name
+        self.trainer_name = trainer
+        self.model = model
+        self.data = data
+        self.shuffle = shuffle
+        self.trainer_kwargs = dict(trainer_kwargs, num_epoch=num_epoch)
+        self.result: Any = None
+        self.history: Optional[list] = None
+        self.training_time: Optional[float] = None
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+
+    def run(self):
+        cls = _trainers()[self.trainer_name]
+        trainer = cls(self.model, **self.trainer_kwargs)
+        dataset = self.data() if callable(self.data) else self.data
+        if not isinstance(dataset, Dataset):
+            raise TypeError(f"Job data must resolve to a Dataset, "
+                            f"got {type(dataset)}")
+        self.started_at = time.time()
+        self.result = trainer.train(dataset, shuffle=self.shuffle)
+        self.finished_at = time.time()
+        self.history = trainer.get_history()
+        self.training_time = trainer.get_training_time()
+        return self.result
+
+    def describe(self) -> dict:
+        return {"job_name": self.job_name, "trainer": self.trainer_name,
+                "trainer_kwargs": {k: v for k, v in self.trainer_kwargs.items()
+                                   if isinstance(v, (int, float, str, bool))},
+                "training_time": self.training_time}
+
+
+class Punchcard:
+    """An ordered queue of jobs, optionally loaded from a JSON spec file.
+
+    JSON shape: ``[{"job_name": ..., "trainer": "ADAG", "model":
+    "distkeras_tpu.models.mlp:mnist_mlp", "data":
+    "distkeras_tpu.data.dataset:synthetic_mnist", ...kwargs}]`` — model/data
+    entries are dotted ``module:callable`` paths invoked with no args.
+    """
+
+    def __init__(self, jobs: Optional[list] = None,
+                 path: Optional[str] = None):
+        self.jobs: list[Job] = list(jobs or [])
+        if path is not None:
+            self.jobs.extend(self._load(path))
+        self.results: list[dict] = []
+
+    @staticmethod
+    def _resolve(dotted: str) -> Callable:
+        module, _, attr = dotted.partition(":")
+        import importlib
+
+        return getattr(importlib.import_module(module), attr)
+
+    @classmethod
+    def _load(cls, path: str) -> list[Job]:
+        with open(path) as f:
+            specs = json.load(f)
+        jobs = []
+        for spec in specs:
+            spec = dict(spec)
+            model = cls._resolve(spec.pop("model"))()
+            data = cls._resolve(spec.pop("data"))
+            jobs.append(Job(model=model, data=data, **spec))
+        return jobs
+
+    def submit(self, job: Job):
+        self.jobs.append(job)
+
+    def run(self) -> list[dict]:
+        """Run every job in order; returns their describe() dicts."""
+        for job in self.jobs:
+            job.run()
+            self.results.append(job.describe())
+        return self.results

@@ -1,3 +1,30 @@
+from distkeras_tpu.models.bert import BertMLM, bert_base, bert_tiny
+from distkeras_tpu.models.cnn import CIFARConvNet, cifar10_cnn
 from distkeras_tpu.models.mlp import MLP, mnist_mlp
+from distkeras_tpu.models.resnet import (
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+)
+from distkeras_tpu.models.vit import ViT, vit_base, vit_large, vit_tiny
 
-__all__ = ["MLP", "mnist_mlp"]
+__all__ = [
+    "BertMLM",
+    "CIFARConvNet",
+    "MLP",
+    "ResNet",
+    "ViT",
+    "bert_base",
+    "bert_tiny",
+    "cifar10_cnn",
+    "mnist_mlp",
+    "resnet18",
+    "resnet34",
+    "resnet50",
+    "resnet101",
+    "vit_base",
+    "vit_large",
+    "vit_tiny",
+]

@@ -1,0 +1,59 @@
+"""Convolutional nets — the reference's CIFAR/convnet example family.
+
+The reference builds convnets in example scripts with Keras Sequential
+(Conv2D/MaxPooling2D/Dense stacks); BASELINE config 2 is "CIFAR-10 CNN,
+DOWNPOUR async SGD". This module ships that model in-tree as a flax module.
+
+TPU notes: NHWC layout (XLA's native conv layout on TPU), channel counts in
+multiples of 8/128 where affordable, bfloat16 compute with float32 params,
+and a float32 head for loss stability.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CIFARConvNet(nn.Module):
+    """Conv stack for 32x32 RGB images (CIFAR-10 shape).
+
+    Two conv blocks (conv-relu-conv-relu-maxpool) then a dense head — the
+    canonical Keras CIFAR example shape, sized so the matmul-heavy layers tile
+    onto the MXU.
+    """
+
+    channels: Sequence[int] = (64, 128)
+    dense_width: int = 256
+    num_classes: int = 10
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        if x.ndim == 2:  # flat feature vectors -> NHWC (reference Reshape path)
+            side = int(round((x.shape[-1] // 3) ** 0.5))
+            x = x.reshape((x.shape[0], side, side, 3))
+        for i, ch in enumerate(self.channels):
+            x = nn.Conv(ch, (3, 3), padding="SAME", dtype=self.dtype,
+                        name=f"conv_{i}a")(x)
+            x = nn.relu(x)
+            x = nn.Conv(ch, (3, 3), padding="SAME", dtype=self.dtype,
+                        name=f"conv_{i}b")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.dense_width, dtype=self.dtype, name="dense")(x)
+        x = nn.relu(x)
+        if self.dropout_rate > 0.0:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def cifar10_cnn(**kw) -> CIFARConvNet:
+    """The BASELINE config-2 model."""
+    return CIFARConvNet(**kw)

@@ -1,0 +1,137 @@
+"""ResNet family — the flagship model (BASELINE config 3: ResNet-50/ImageNet).
+
+The reference has no in-tree model zoo (models live in Keras example
+scripts); the north-star benchmark nevertheless names ResNet-50/ImageNet with
+ADAG at >=50% MFU, so this is the flagship.
+
+TPU-first design choices:
+- NHWC layout, 3x3/1x1 convs — XLA tiles these straight onto the MXU.
+- **GroupNorm instead of BatchNorm.** BatchNorm needs mutable running stats
+  (impure step, host round-trips on sync) and cross-replica stat all-reduces;
+  GroupNorm is stateless, batch-size independent, and fuses into the conv
+  epilogue. This keeps every train step a pure function — the property the
+  whole substrate (shard_map + scanned rounds) relies on.
+- bfloat16 compute / float32 params; float32 classifier head.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+def group_norm(channels: int, dtype, name: str, **kw) -> nn.GroupNorm:
+    """GroupNorm with a group count that always divides ``channels``
+    (32 groups at ImageNet widths, fewer for tiny test models)."""
+    return nn.GroupNorm(num_groups=math.gcd(32, channels), dtype=dtype,
+                        name=name, **kw)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut on shape change."""
+
+    filters: int  # bottleneck width; block output is 4*filters
+    strides: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(group_norm, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = norm(self.filters, name="norm1")(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                 padding="SAME", name="conv2")(y)
+        y = norm(self.filters, name="norm2")(y)
+        y = nn.relu(y)
+        y = conv(4 * self.filters, (1, 1), name="conv3")(y)
+        # zero-init the last norm's scale so blocks start as identity
+        y = norm(4 * self.filters, name="norm3",
+                 scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(4 * self.filters, (1, 1),
+                            strides=(self.strides, self.strides),
+                            name="proj")(residual)
+            residual = norm(4 * self.filters, name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    """3x3 -> 3x3 block (ResNet-18/34)."""
+
+    filters: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(group_norm, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                 padding="SAME", name="conv1")(x)
+        y = norm(self.filters, name="norm1")(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), padding="SAME", name="conv2")(y)
+        y = norm(self.filters, name="norm2",
+                 scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1),
+                            strides=(self.strides, self.strides),
+                            name="proj")(residual)
+            residual = norm(self.filters, name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """ResNet v1.5 (stride-2 in the 3x3 conv of downsampling bottlenecks)."""
+
+    stage_sizes: Sequence[int]
+    block: ModuleDef = BottleneckBlock
+    num_classes: int = 1000
+    width: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train  # stateless norms: train/eval forward passes are identical
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, name="conv_stem")(x)
+        x = group_norm(self.width, dtype=self.dtype, name="norm_stem")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, num_blocks in enumerate(self.stage_sizes):
+            for j in range(num_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block(filters=self.width * 2 ** i, strides=strides,
+                               dtype=self.dtype,
+                               name=f"stage{i}_block{j}")(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def resnet18(**kw) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), block=BasicBlock, **kw)
+
+
+def resnet34(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=BasicBlock, **kw)
+
+
+def resnet50(**kw) -> ResNet:
+    """BASELINE config-3 / north-star flagship."""
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=BottleneckBlock, **kw)
+
+
+def resnet101(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 23, 3), block=BottleneckBlock, **kw)

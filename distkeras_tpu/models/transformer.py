@@ -1,0 +1,71 @@
+"""Transformer encoder blocks — shared by BERT and ViT.
+
+Pre-LayerNorm encoder (more stable than post-LN at depth; the modern
+default), bf16 compute with fp32 LayerNorm/softmax, GELU MLP whose matmuls
+carry the FLOPs onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.ops.attention import MultiHeadAttention
+
+
+class MlpBlock(nn.Module):
+    mlp_dim: int
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        width = x.shape[-1]
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype, name="fc1")(x)
+        y = nn.gelu(y)
+        if self.dropout_rate > 0.0:
+            y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        return nn.Dense(width, dtype=self.dtype, name="fc2")(y)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, mask: Optional[jax.Array] = None,
+                 train: bool = False):
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(self.dtype)
+        y = MultiHeadAttention(self.num_heads, dtype=self.dtype,
+                               name="attn")(y, mask=mask)
+        if self.dropout_rate > 0.0:
+            y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
+        y = MlpBlock(self.mlp_dim, self.dropout_rate, self.dtype,
+                     name="mlp")(y, train=train)
+        return x + y
+
+
+class Encoder(nn.Module):
+    """Stack of encoder blocks with a final LayerNorm."""
+
+    num_layers: int
+    num_heads: int
+    mlp_dim: int
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, mask: Optional[jax.Array] = None,
+                 train: bool = False):
+        for i in range(self.num_layers):
+            x = EncoderBlock(self.num_heads, self.mlp_dim, self.dropout_rate,
+                             self.dtype, name=f"layer_{i}")(
+                x, mask=mask, train=train)
+        return nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)

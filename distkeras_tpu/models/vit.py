@@ -1,0 +1,64 @@
+"""Vision Transformer (BASELINE config 5: ViT-L, pjit data-parallel).
+
+Standard ViT: conv patch embedding (a strided conv = one big MXU matmul per
+patch grid), learned position embeddings, CLS token, pre-LN encoder, fp32
+classifier head.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.models.transformer import Encoder
+
+
+class ViT(nn.Module):
+    num_classes: int = 1000
+    patch_size: int = 16
+    num_layers: int = 24
+    num_heads: int = 16
+    width: int = 1024
+    mlp_dim: int = 4096
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        p = self.patch_size
+        x = nn.Conv(self.width, (p, p), strides=(p, p), padding="VALID",
+                    dtype=self.dtype, name="patch_embed")(x)
+        b, h, w, c = x.shape
+        x = x.reshape((b, h * w, c))
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, self.width))
+        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, c)).astype(self.dtype),
+                             x], axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, h * w + 1, self.width))
+        x = x + pos.astype(self.dtype)
+        x = Encoder(self.num_layers, self.num_heads, self.mlp_dim,
+                    self.dropout_rate, self.dtype, name="encoder")(
+            x, train=train)
+        cls_out = x[:, 0]
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(cls_out).astype(jnp.float32)
+
+
+def vit_base(**kw) -> ViT:
+    defaults = dict(num_layers=12, num_heads=12, width=768, mlp_dim=3072)
+    defaults.update(kw)
+    return ViT(**defaults)
+
+
+def vit_large(**kw) -> ViT:
+    """BASELINE config-5 model (ViT-L/16)."""
+    return ViT(**kw)
+
+
+def vit_tiny(**kw) -> ViT:
+    """Test-sized ViT for CI and CPU runs."""
+    defaults = dict(num_classes=10, patch_size=4, num_layers=2, num_heads=2,
+                    width=32, mlp_dim=64, dtype=jnp.float32)
+    defaults.update(kw)
+    return ViT(**defaults)

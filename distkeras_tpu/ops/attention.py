@@ -1,0 +1,75 @@
+"""Attention ops — the compute core of the transformer model family.
+
+The reference has no attention anywhere (MLPs/convnets only, SURVEY.md §2);
+BASELINE configs 4-5 (BERT-base MLM, ViT-L) require it, and the task spec
+makes long-context first-class. This module holds the single-device paths:
+
+- ``dot_product_attention``: einsum attention, bf16-friendly, fp32 softmax.
+  XLA fuses the scale/mask/softmax chain into the two MXU matmuls.
+- ``MultiHeadAttention``: flax module with fused QKV projection (one matmul
+  instead of three — fewer, larger MXU ops).
+
+The distributed path (ring attention over a sequence-parallel mesh axis)
+lives in ``ops/ring_attention.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# Large-but-finite mask value (flax convention): keeps softmax defined (and
+# its gradient zero, not NaN) even for rows whose keys are ALL masked — e.g.
+# an all-padding row from ModelPredictor's static-shape tail padding.
+MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          mask: Optional[jax.Array] = None,
+                          causal: bool = False) -> jax.Array:
+    """Attention over [batch, seq, heads, head_dim] tensors.
+
+    Softmax runs in float32 regardless of input dtype (bf16 logits overflow
+    long-sequence softmax); the output is cast back to the input dtype.
+    """
+    dtype = q.dtype
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        q_pos = jnp.arange(q.shape[1])[:, None]
+        k_pos = jnp.arange(k.shape[1])[None, :]
+        logits = jnp.where(q_pos >= k_pos, logits, MASK_VALUE)
+    if mask is not None:
+        # mask: [batch, kv_seq] (padding) or broadcastable to [b, h, q, k]
+        if mask.ndim == 2:
+            mask = mask[:, None, None, :]
+        logits = jnp.where(mask, logits, MASK_VALUE)
+    weights = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+class MultiHeadAttention(nn.Module):
+    """MHA with fused QKV projection. Input/output: [batch, seq, width]."""
+
+    num_heads: int
+    qkv_features: Optional[int] = None
+    dtype: jnp.dtype = jnp.bfloat16
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x, mask: Optional[jax.Array] = None):
+        width = x.shape[-1]
+        features = self.qkv_features or width
+        head_dim = features // self.num_heads
+        assert features % self.num_heads == 0
+
+        qkv = nn.Dense(3 * features, dtype=self.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        split = lambda t: t.reshape(t.shape[:2] + (self.num_heads, head_dim))
+        out = dot_product_attention(split(q), split(k), split(v),
+                                    mask=mask, causal=self.causal)
+        out = out.reshape(out.shape[:2] + (features,))
+        return nn.Dense(width, dtype=self.dtype, name="out")(out)

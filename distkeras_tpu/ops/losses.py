@@ -41,6 +41,17 @@ def binary_crossentropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     )
 
 
+def masked_lm(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """MLM loss: sparse crossentropy over positions with label >= 0; negative
+    labels (unmasked positions) are ignored. Mean over masked positions."""
+    valid = labels >= 0
+    safe = jnp.where(valid, labels, 0).astype(jnp.int32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    count = jnp.maximum(jnp.sum(valid), 1)
+    return -jnp.sum(jnp.where(valid, ll, 0.0)) / count
+
+
 def mean_squared_error(preds: jax.Array, targets: jax.Array) -> jax.Array:
     return jnp.mean(jnp.square(preds - targets))
 
@@ -53,6 +64,7 @@ _LOSSES: dict[str, LossFn] = {
     "categorical_crossentropy": categorical_crossentropy,
     "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
     "binary_crossentropy": binary_crossentropy,
+    "masked_lm": masked_lm,
     "mse": mean_squared_error,
     "mean_squared_error": mean_squared_error,
     "mae": mean_absolute_error,

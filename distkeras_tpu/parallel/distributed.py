@@ -1,0 +1,116 @@
+"""Multi-host distributed backend — the networking.py replacement.
+
+Reference parity: ``distkeras/networking.py`` (unverified, mount empty) is a
+hand-rolled TCP layer — ``determine_host_address``, ``connect``,
+``send_data``/``recv_data`` moving pickled dicts between Spark executors and
+the driver's parameter-server socket. SURVEY.md §5 calls the swap: here the
+"wire protocol" is XLA collectives compiled into the step (psum/all_gather
+over ICI within a slice, DCN across slices), and the only host-level
+networking is jax's coordination service, wrapped below.
+
+Scaling model (How-to-Scale-Your-Model recipe): pick a mesh, annotate
+shardings, let XLA insert collectives. ``multihost_mesh`` lays the
+data-parallel ("workers") axis across slices/hosts so its all-reduces ride
+DCN-friendly hierarchies, and keeps the model axis inside a slice where ICI
+bandwidth lives.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from distkeras_tpu.parallel import mesh as mesh_lib
+
+
+def determine_host_address() -> str:
+    """Reference-parity helper: this host's routable IP address."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))  # no packets sent; just picks an interface
+        return s.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        s.close()
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               local_device_ids: Optional[Sequence[int]] = None) -> None:
+    """Join the jax coordination service (multi-host entry point).
+
+    Call this FIRST, before anything touches the jax backend. With no
+    arguments it self-detects: on a TPU pod / launcher-managed job (cluster
+    env vars present) it joins the coordination service with inferred
+    arguments; on a plain single host it is a no-op — so driver scripts are
+    portable between one chip and a pod, the analogue of the reference
+    working the same in Spark local[N] and cluster mode.
+    """
+    explicit = any(a is not None for a in
+                   (coordinator_address, num_processes, process_id))
+    if not explicit and not _cluster_env_present():
+        return  # plain single host — nothing to coordinate
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+
+
+def _cluster_env_present() -> bool:
+    """True when a supported launcher's environment is visible (the cases
+    jax.distributed.initialize can self-infer from)."""
+    import os
+
+    markers = (
+        "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+        "MEGASCALE_COORDINATOR_ADDRESS",      # multi-slice TPU
+        "CLOUD_TPU_TASK_ID",
+        "OMPI_MCA_orte_hnp_uri",              # OpenMPI
+    )
+    if any(os.environ.get(m) for m in markers):
+        return True
+    # pod metadata lists >1 worker (a single-host TPU VM also carries this
+    # var — sometimes empty — so require an actual multi-host list)
+    if "," in os.environ.get("TPU_WORKER_HOSTNAMES", ""):
+        return True
+    if int(os.environ.get("SLURM_JOB_NUM_NODES", "1") or 1) > 1:
+        return True
+    return False
+
+
+def multihost_mesh(num_workers: Optional[int] = None,
+                   model_parallelism: int = 1) -> Mesh:
+    """Build the (workers, model) mesh over ALL processes' devices.
+
+    The model axis is laid out over adjacent devices (same host/slice: ICI);
+    the workers axis spans hosts (DCN-tolerant all-reduce). With
+    ``jax.process_count() == 1`` this degrades to ``mesh.make_mesh``.
+    """
+    devices = jax.devices()  # global across processes
+    if num_workers is None:
+        num_workers = len(devices) // model_parallelism
+    need = num_workers * model_parallelism
+    if need > len(devices):
+        raise ValueError(
+            f"Mesh needs {need} devices, {len(devices)} visible globally")
+    grid = np.asarray(devices[:need]).reshape(num_workers, model_parallelism)
+    return Mesh(grid, (mesh_lib.WORKER_AXIS, mesh_lib.MODEL_AXIS))
+
+
+def process_info() -> dict:
+    """Topology snapshot for logging/debugging (the reference printed the
+    PS host/port; we print the coordination-service view)."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": [str(d) for d in jax.local_devices()],
+        "global_device_count": len(jax.devices()),
+        "host_address": determine_host_address(),
+    }

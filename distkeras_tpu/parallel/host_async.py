@@ -1,0 +1,167 @@
+"""Host-driven TRUE-async mode: wall-clock asynchrony against a live center.
+
+The substrate (parallel/substrate.py) *emulates* asynchrony deterministically
+inside one compiled program — the fast path. This module is the other half of
+the reference's story: like dist-keras's socket parameter server
+(``parameter_servers.py``/``workers.py`` — unverified, mount empty), workers
+here run CONCURRENTLY (host threads standing in for Spark executors), each
+looping pull → local window → commit against a ParameterServer whose center
+updates live between any two of a worker's steps. Staleness is real thread
+scheduling, not a rotation schedule.
+
+TPU mapping: each worker's window is ONE jitted scan (compiled once, shared
+by all workers); commits fold on-device via the jitted PS fold. Threads
+serialize on the chip at window granularity, which is exactly the interleaving
+the reference's executors had against the driver's lock — but with the center
+in HBM instead of driver RAM, and windows as compiled programs instead of
+eager Keras steps.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu import engine
+from distkeras_tpu.parameter_servers import (
+    DeltaParameterServer,
+    DynSGDParameterServer,
+    ParameterServer,
+)
+from distkeras_tpu.parallel.strategies import Strategy
+
+
+def server_for(strategy: Strategy, params) -> ParameterServer:
+    """The reference's trainer→server pairing (SURVEY.md §2)."""
+    if strategy.name == "dynsgd":
+        return DynSGDParameterServer(params)
+    return DeltaParameterServer(params)
+
+
+def make_window_fn(model, loss, tx, strategy: Strategy, window: int,
+                   metric_names: Sequence[str], seed: int):
+    """One worker's compiled round: λ local steps + commit computation.
+
+    (carry, center, batches, fold_key) -> (carry, commit, metrics dict)
+    where batches leaves are [window, batch, ...]. Compiled once; every
+    worker thread calls the same executable.
+    """
+    grad_fn = engine.make_grad_fn(model, loss)
+    base_key = jax.random.key(seed)
+
+    def window_fn(carry, center, batches, fold_key):
+        carry = strategy.round_start(carry, center)
+
+        def one_step(c, xs):
+            batch, i = xs
+            rng = jax.random.fold_in(jax.random.fold_in(base_key, fold_key), i)
+            c, m = strategy.local_step(grad_fn, tx, c, batch,
+                                       rngs={"dropout": rng})
+            out = {"loss": m["loss"]}
+            for name in metric_names:
+                out[name] = engine.compute_metric(name, m["logits"],
+                                                  batch["labels"])
+            return c, out
+
+        idx = jnp.arange(window, dtype=jnp.int32)
+        carry, ms = jax.lax.scan(one_step, carry, (batches, idx))
+        commit = strategy.commit(carry, center, window)
+        if not strategy.resets_to_center:
+            # local side of the elastic update (EASGD family); the DOWNPOUR
+            # family re-pulls the live center at its next round_start instead
+            carry = strategy.post_commit(carry, commit, None)
+        return carry, commit, ms
+
+    return jax.jit(window_fn)
+
+
+class HostAsyncRunner:
+    """Run N concurrent workers against a live parameter server.
+
+    ``shards``: per-worker lists of staged batch dicts (features/labels),
+    each leaf [window, batch, ...]. History and staleness are recorded
+    per-worker and merged in commit order.
+    """
+
+    def __init__(self, model, loss, tx, strategy: Strategy, window: int,
+                 metrics: Sequence[str] = (), seed: int = 0):
+        self.strategy = strategy
+        self.window = int(window)
+        self.window_fn = make_window_fn(model, loss, tx, strategy, window,
+                                        tuple(metrics), seed)
+        self.tx = tx
+
+    def run(self, init_params, epoch_shards: Sequence[Sequence[Sequence[dict]]]
+            ) -> tuple:
+        """``epoch_shards[epoch][worker]`` is that worker's list of staged
+        rounds for that epoch (per-epoch staging preserves the sync path's
+        reshuffle-every-epoch semantics; pass the same object per epoch when
+        not shuffling). Workers progress through epochs without barriers —
+        true asynchrony extends across epoch boundaries too."""
+        num_workers = len(epoch_shards[0])
+        ps = server_for(self.strategy, init_params)
+        histories: list[list[dict]] = [[] for _ in range(num_workers)]
+        staleness: list[list[int]] = [[] for _ in range(num_workers)]
+        errors: list = []
+
+        def worker(k: int):
+            try:
+                carry = self.strategy.init_carry(init_params, self.tx)
+                fold = 0
+                for shards in epoch_shards:
+                    for rnd, batches in enumerate(shards[k]):
+                        center, clock = ps.pull()
+                        carry, commit, ms = self.window_fn(
+                            carry, center, batches,
+                            np.int32(k * 1_000_003 + fold))
+                        jax.block_until_ready(commit)
+                        clock_at_fold = ps.commit(commit, last_update=clock)
+                        staleness[k].append(clock_at_fold - clock)
+                        ms = jax.device_get(ms)
+                        n = len(ms["loss"])
+                        histories[k].extend(
+                            {key: float(v[i]) for key, v in ms.items()}
+                            for i in range(n))
+                        fold += 1
+            except Exception as e:  # surface thread failures to the caller
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(k,), daemon=True)
+                   for k in range(num_workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        center, _ = ps.pull()
+        history = [h for hs in histories for h in hs]
+        stal = [float(s) for ss in staleness for s in ss]
+        return jax.device_get(center), history, stal, ps.num_updates
+
+
+def stage_worker_shards(shards, features_col: str, label_col: str,
+                        batch_size: int, window: int) -> list:
+    """Host-side staging for the async runner: per-worker lists of
+    [window, batch, ...] batch dicts (rounds of λ minibatches)."""
+    out = []
+    per_round = batch_size * window
+    for s in shards:
+        rounds = len(s) // per_round
+        rs = []
+        for r in range(rounds):
+            lo = r * per_round
+            feats = np.asarray(s[features_col][lo:lo + per_round])
+            labs = np.asarray(s[label_col][lo:lo + per_round])
+            rs.append({
+                "features": feats.reshape((window, batch_size) +
+                                          feats.shape[1:]),
+                "labels": labs.reshape((window, batch_size) +
+                                       labs.shape[1:]),
+            })
+        out.append(rs)
+    return out

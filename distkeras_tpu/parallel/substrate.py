@@ -129,7 +129,7 @@ def init_center_and_carries(params, tx, strategy: Strategy, mesh: Mesh,
 
 def stage_epoch_data(shards, features_col: str, label_col: str,
                      batch_size: int, window: int, mesh: Mesh,
-                     min_rounds: Optional[int] = None):
+                     max_rounds: Optional[int] = None):
     """Host-side data staging: per-worker shards -> one sharded device array
     shaped (workers, rounds, window, batch, ...).
 
@@ -139,8 +139,8 @@ def stage_epoch_data(shards, features_col: str, label_col: str,
     """
     per_round = batch_size * window
     rounds = min(len(s) // per_round for s in shards)
-    if min_rounds is not None:
-        rounds = min(rounds, min_rounds)
+    if max_rounds is not None:
+        rounds = min(rounds, max_rounds)
     if rounds == 0:
         raise ValueError(
             f"Shards of sizes {[len(s) for s in shards]} cannot form a "

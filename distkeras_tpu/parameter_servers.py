@@ -50,7 +50,10 @@ class ParameterServer:
         with self._lock:
             return self.center_variable, self.num_updates
 
-    def commit(self, delta: Any, last_update: int = 0) -> None:
+    def commit(self, delta: Any, last_update: int = 0) -> int:
+        """Fold a delta into the center. Returns the server clock at fold
+        time (BEFORE this commit increments it) — the committer's true
+        staleness is that value minus the clock at its pull."""
         raise NotImplementedError
 
     # reference lifecycle names (no socket to start/stop, kept as no-ops so
@@ -71,11 +74,13 @@ class DeltaParameterServer(ParameterServer):
     """center += delta (DOWNPOUR/ADAG/(A)EASGD server rule; ADAG's window
     normalization happens worker-side, see NUMERICS.md)."""
 
-    def commit(self, delta: Any, last_update: int = 0) -> None:
+    def commit(self, delta: Any, last_update: int = 0) -> int:
         with self._lock:
+            at_fold = self.num_updates
             self.center_variable = _fold(self.center_variable, delta,
                                          jnp.float32(1.0))
             self.num_updates += 1
+            return at_fold
 
 
 # The reference gives ADAG its own server class; the fold is identical to
@@ -87,9 +92,10 @@ class DynSGDParameterServer(ParameterServer):
     """center += delta / (staleness + 1), staleness = server clock at commit
     minus server clock at the committer's last pull."""
 
-    def commit(self, delta: Any, last_update: int = 0) -> None:
+    def commit(self, delta: Any, last_update: int = 0) -> int:
         with self._lock:
-            staleness = self.num_updates - int(last_update)
+            at_fold = self.num_updates
+            staleness = at_fold - int(last_update)
             if staleness < 0:
                 raise ValueError(
                     f"last_update {last_update} is ahead of the server clock "
@@ -97,3 +103,4 @@ class DynSGDParameterServer(ParameterServer):
             self.center_variable = _fold(self.center_variable, delta,
                                          jnp.float32(1.0 / (staleness + 1)))
             self.num_updates += 1
+            return at_fold

@@ -1,0 +1,93 @@
+"""Predictors — distributed inference appending a prediction column.
+
+Reference parity: ``distkeras/predictors.py`` (unverified, mount empty)
+broadcasts the serialized Keras model and runs ``mapPartitions`` with a
+**row-at-a-time** ``model.predict`` (SURVEY.md §3.3 flags this as slow).
+Behavior parity is "adds a prediction column"; the TPU-native execution is a
+jit-compiled **batched** forward pass, optionally sharded over the worker
+mesh axis so big scoring jobs ride all chips.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.data.dataset import Dataset
+
+
+class Predictor:
+    """Base predictor: ``predict(dataset) -> dataset + output_col``."""
+
+    def predict(self, dataset: Dataset) -> Dataset:
+        raise NotImplementedError
+
+
+class ModelPredictor(Predictor):
+    """Append the model's raw output vector for every row.
+
+    kwargs mirror the reference (keras_model -> model+params,
+    features_col, output_col). ``batch_size`` is the device batch; the tail
+    is padded to keep shapes static and sliced off after.
+    """
+
+    def __init__(self, model, params, features_col: str = "features",
+                 output_col: str = "prediction", batch_size: int = 512,
+                 mesh=None):
+        self.model = model
+        self.params = params
+        self.features_col = features_col
+        self.output_col = output_col
+        self.batch_size = int(batch_size)
+        self.mesh = mesh
+
+        def forward(params, x):
+            return model.apply({"params": params}, x, train=False)
+
+        if mesh is not None:
+            from distkeras_tpu.parallel import mesh as mesh_lib
+
+            sharding = NamedSharding(mesh, P(mesh_lib.WORKER_AXIS))
+            self._forward = jax.jit(
+                forward,
+                in_shardings=(NamedSharding(mesh, P()), sharding),
+                out_shardings=sharding)
+            self._num_shards = mesh.shape[mesh_lib.WORKER_AXIS]
+            self.params = mesh_lib.put_replicated(params, mesh)
+        else:
+            self._forward = jax.jit(forward)
+            self._num_shards = 1
+
+    def predict(self, dataset: Dataset) -> Dataset:
+        x = np.asarray(dataset[self.features_col], np.float32)
+        n = len(x)
+        # pad to a full (batch * shards) multiple: static shapes, all chips busy
+        chunk = self.batch_size * self._num_shards
+        pad = (-n) % chunk
+        if pad:
+            x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+        outs = []
+        for start in range(0, len(x), chunk):
+            outs.append(np.asarray(
+                self._forward(self.params, x[start:start + chunk])))
+        y = np.concatenate(outs)[:n]
+        return dataset.with_column(self.output_col, y)
+
+
+class ModelClassifier(ModelPredictor):
+    """Predictor that appends the argmax class index instead of the raw
+    output vector (convenience composition used throughout the reference's
+    examples: ModelPredictor + LabelIndexTransformer)."""
+
+    def predict(self, dataset: Dataset) -> Dataset:
+        from distkeras_tpu.transformers import LabelIndexTransformer
+
+        scored = super().predict(dataset)
+        out = LabelIndexTransformer(
+            input_col=self.output_col, output_col=self.output_col,
+            activation_threshold=0.5,
+            from_logits=True).transform(scored)  # models emit logits
+        return out

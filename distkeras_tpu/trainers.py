@@ -129,16 +129,37 @@ class DistributedTrainer(Trainer):
                  num_workers: Optional[int] = None,
                  communication_window: int = 5,
                  master_port: Optional[int] = None,  # parity no-op
-                 mesh=None, seed: int = 0, **strategy_kwargs):
+                 mesh=None, seed: int = 0, mode: str = "sync",
+                 **strategy_kwargs):
         super().__init__(model, loss, worker_optimizer, learning_rate,
                          metrics, features_col, label_col, batch_size,
                          num_epoch, seed)
         from distkeras_tpu.parallel import mesh as mesh_lib
 
-        self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(num_workers)
-        self.num_workers = self.mesh.shape[mesh_lib.WORKER_AXIS]
+        if mode not in ("sync", "host_async"):
+            raise ValueError(f"mode must be 'sync' or 'host_async', "
+                             f"got {mode!r}")
+        self.mode = mode
+        if mode == "host_async":
+            # thread-per-worker against a live PS; no mesh sharding involved
+            if mesh is not None:
+                raise ValueError(
+                    "mesh and mode='host_async' are contradictory: async "
+                    "workers are host threads, not mesh replicas")
+            self.mesh = None
+            if num_workers is None:
+                raise ValueError("host_async mode needs explicit num_workers")
+            self.num_workers = int(num_workers)
+        else:
+            self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(
+                num_workers)
+            self.num_workers = self.mesh.shape[mesh_lib.WORKER_AXIS]
         self.communication_window = int(communication_window)
         self.strategy = self._make_strategy(**strategy_kwargs)
+        if mode == "host_async" and not self.strategy.exchanges:
+            raise ValueError(
+                "host_async mode requires an exchanging strategy "
+                "(DOWNPOUR/ADAG/DynSGD/AEASGD/EAMSGD)")
         self.num_updates = 0
         self.staleness_history: list[float] = []
 
@@ -165,16 +186,24 @@ class DistributedTrainer(Trainer):
             for si in range(win):
                 self.history.append(
                     {k: float(v[:, ri, si].mean()) for k, v in ms.items()})
-        self.num_updates += rounds * self.num_workers
+        if self.strategy.exchanges:  # PS commit clock: only real commits count
+            self.num_updates += rounds * self.num_workers
+
+    def _setup_state(self, dataset: Dataset):
+        """(center, carries) placement; split out so subclasses with their own
+        init (Ensemble) don't pay a wasted full-model init."""
+        state = self._init_params(dataset)
+        return self._init_carries(state.params)
 
     def train(self, dataset: Dataset, shuffle: bool = False):
         from distkeras_tpu.parallel import substrate
 
+        if self.mode == "host_async":
+            return self._train_host_async(dataset, shuffle)
         self._start()
         self._check_trainable(
             dataset, self.batch_size * self.communication_window * self.num_workers)
-        state = self._init_params(dataset)
-        center, carries = self._init_carries(state.params)
+        center, carries = self._setup_state(dataset)
         epoch_fn = substrate.build_epoch_fn(
             self.model, self.loss, self.tx, self.strategy, self.mesh,
             self.num_workers, self.communication_window, self.metrics,
@@ -183,12 +212,15 @@ class DistributedTrainer(Trainer):
         self.staleness_history = []
         self.num_updates = 0
         round_offset = 0
+        staged = None  # shuffle=False: stage the (identical) epoch data once
         for epoch in range(self.num_epoch):
-            ds = dataset.shuffle(self.seed + epoch) if shuffle else dataset
-            shards = ds.repartition(self.num_workers)
-            data, rounds = substrate.stage_epoch_data(
-                shards, self.features_col, self.label_col, self.batch_size,
-                self.communication_window, self.mesh)
+            if shuffle or staged is None:
+                ds = dataset.shuffle(self.seed + epoch) if shuffle else dataset
+                staged = substrate.stage_epoch_data(
+                    ds.repartition(self.num_workers), self.features_col,
+                    self.label_col, self.batch_size,
+                    self.communication_window, self.mesh)
+            data, rounds = staged
             center, carries, ms = epoch_fn(center, carries, data,
                                            np.int32(round_offset))
             round_offset += rounds
@@ -200,6 +232,40 @@ class DistributedTrainer(Trainer):
     def _finalize(self, center, carries):
         """Async trainers return the parameter server's center variable."""
         return jax.device_get(center)
+
+    def _train_host_async(self, dataset: Dataset, shuffle: bool):
+        """True wall-clock asynchrony: thread-per-worker against a live PS
+        (parallel/host_async.py). Staleness here is real scheduling, not the
+        sync substrate's deterministic rotation."""
+        from distkeras_tpu.parallel import host_async
+
+        self._start()
+        self._check_trainable(
+            dataset,
+            self.batch_size * self.communication_window * self.num_workers)
+        state = self._init_params(dataset)
+
+        def stage(ds):
+            return host_async.stage_worker_shards(
+                ds.repartition(self.num_workers), self.features_col,
+                self.label_col, self.batch_size, self.communication_window)
+
+        if shuffle:  # per-epoch reshuffle, matching the sync path
+            epoch_shards = [stage(dataset.shuffle(self.seed + e))
+                            for e in range(self.num_epoch)]
+        else:
+            epoch_shards = [stage(dataset)] * self.num_epoch
+        runner = host_async.HostAsyncRunner(
+            self.model, self.loss, self.tx, self.strategy,
+            self.communication_window, self.metrics, self.seed)
+        params, history, staleness, num_updates = runner.run(
+            state.params, epoch_shards)
+        self.history = history
+        self.staleness_history = staleness
+        self.num_updates = num_updates
+        self.params = params
+        self._stop()
+        return self.params
 
 
 class DOWNPOUR(DistributedTrainer):
@@ -260,16 +326,15 @@ class EnsembleTrainer(DistributedTrainer):
 
     strategy_name = "independent"
 
-    def _init_carries(self, center_params):
+    def _setup_state(self, dataset: Dataset):
         from distkeras_tpu.parallel import mesh as mesh_lib
-        from distkeras_tpu.parallel import substrate
 
-        del center_params
+        col = np.asarray(dataset[self.features_col])
+        sample = np.zeros((1,) + col.shape[1:], col.dtype)
         keys = jax.random.split(jax.random.key(self.seed), self.num_workers)
-        sample = {"features": np.zeros((1,) + self._feature_shape, np.float32)}
 
         def init_one(k):
-            variables = self.model.init(k, sample["features"], train=False)
+            variables = self.model.init(k, sample, train=False)
             return self.strategy.init_carry(variables["params"], self.tx)
 
         stacked = jax.vmap(init_one)(keys)
@@ -278,10 +343,6 @@ class EnsembleTrainer(DistributedTrainer):
             jax.tree.map(lambda x: x[0], jax.device_get(stacked.params)),
             self.mesh)
         return center, carries
-
-    def train(self, dataset: Dataset, shuffle: bool = False):
-        self._feature_shape = np.asarray(dataset[self.features_col][0]).shape
-        return super().train(dataset, shuffle)
 
     def _finalize(self, center, carries):
         host = jax.device_get(carries.params)

@@ -1,0 +1,75 @@
+"""FLOPs counter + MFU math tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu import observability as obs
+
+
+def test_count_flops_matmul():
+    a = jnp.zeros((8, 16))
+    b = jnp.zeros((16, 32))
+    flops = obs.count_flops(lambda a, b: a @ b, a, b)
+    assert flops == 2 * 8 * 16 * 32
+
+
+def test_count_flops_scan_multiplies():
+    a = jnp.zeros((4, 4))
+
+    def f(a):
+        def body(c, _):
+            return c @ a, None
+        out, _ = jax.lax.scan(body, a, None, length=10)
+        return out
+
+    assert obs.count_flops(f, a) == 10 * 2 * 4 * 4 * 4
+
+
+def test_count_flops_conv():
+    x = jnp.zeros((1, 8, 8, 3))
+    k = jnp.zeros((3, 3, 3, 16))
+    f = lambda x, k: jax.lax.conv_general_dilated(
+        x, k, (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    # out 1x8x8x16, each output = 2 * 3*3*3 MACs
+    assert obs.count_flops(f, x, k) == 2 * 8 * 8 * 16 * 27
+
+
+def test_count_flops_through_jit_and_grad():
+    a = jnp.zeros((8, 8))
+
+    @jax.jit
+    def loss(a):
+        return jnp.sum((a @ a) ** 2)
+
+    fwd = obs.count_flops(loss, a)
+    assert fwd == 2 * 8 * 8 * 8
+    both = obs.count_flops(jax.grad(loss), a)
+    assert both >= 3 * fwd  # fwd + two backward matmuls
+
+
+def test_count_flops_resnet_tiny_close_to_known_shape():
+    from distkeras_tpu.models.resnet import resnet50
+
+    model = resnet50(num_classes=1000)
+    x = jnp.zeros((1, 224, 224, 3))
+    shapes = jax.eval_shape(
+        lambda k: model.init(k, x, train=False), jax.random.key(0))
+    params = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)["params"]
+    flops = obs.count_flops(
+        lambda p: model.apply({"params": p}, x, train=False), params)
+    # published ResNet-50 forward ~4.1 GMACs at 224x224 -> 2*MACs ~ 8.2 GFLOPs
+    assert 7.6e9 < flops < 8.7e9, flops
+
+
+def test_mfu_math():
+    assert obs.mfu(1e12, 0.01, num_chips=1, peak_per_chip=1e15) == 0.1
+    assert obs.mfu(0, 0.01) is None
+
+
+def test_step_timer():
+    t = obs.StepTimer()
+    with t.measure(4):
+        pass
+    assert t.mean_step_s >= 0 and t.steps == 4

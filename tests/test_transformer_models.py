@@ -1,0 +1,109 @@
+"""BERT / ViT / attention sanity tests (tiny configs, CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.models.bert import bert_tiny
+from distkeras_tpu.models.vit import vit_tiny
+from distkeras_tpu.ops.attention import dot_product_attention
+from distkeras_tpu.ops.losses import masked_lm
+
+
+def test_dot_product_attention_matches_naive():
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((2, 5, 2, 4)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 7, 2, 4)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 7, 2, 4)), jnp.float32)
+    out = dot_product_attention(q, k, v)
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(4)
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bkhd->bqhd", w, v)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_causal_masking():
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.standard_normal((1, 4, 1, 2)), jnp.float32)
+    k, v = q, q
+    out = dot_product_attention(q, k, v, causal=True)
+    # first position attends only to itself
+    np.testing.assert_allclose(np.asarray(out[0, 0]), np.asarray(v[0, 0]),
+                               rtol=1e-5)
+
+
+def test_padding_mask_ignores_padded_keys():
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((1, 3, 1, 2)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 4, 1, 2)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 4, 1, 2)), jnp.float32)
+    mask = jnp.array([[True, True, False, False]])
+    out = dot_product_attention(q, k, v, mask=mask)
+    ref = dot_product_attention(q, k[:, :2], v[:, :2])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_all_masked_row_no_nan_in_grads():
+    """An all-padding row must not poison gradients with NaN (safe-softmax
+    guard via finite MASK_VALUE)."""
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((2, 3, 1, 2)), jnp.float32)
+    mask = jnp.array([[True, True, True], [False, False, False]])
+
+    def loss(q):
+        out = dot_product_attention(q, q, q, mask=mask)
+        return jnp.sum(out[:1] ** 2)  # loss only uses the valid row
+
+    g = jax.grad(loss)(q)
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_masked_accuracy_ignores_negative_labels():
+    from distkeras_tpu.engine import compute_metric
+
+    logits = jnp.asarray(np.eye(4, dtype=np.float32)[None, [0, 1, 2, 3]])
+    labels = jnp.asarray(np.array([[0, 1, -1, -1]], np.int32))
+    # 2 valid positions, both correct
+    assert float(compute_metric("accuracy", logits, labels)) == 1.0
+    labels2 = jnp.asarray(np.array([[3, 1, -1, -1]], np.int32))
+    assert float(compute_metric("masked_accuracy", logits, labels2)) == 0.5
+
+
+def test_bert_tiny_forward_and_mlm_loss():
+    model = bert_tiny()
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(1, 256, (2, 16)), jnp.int32)
+    params = model.init(jax.random.key(0), ids, train=False)["params"]
+    logits = model.apply({"params": params}, ids, train=False)
+    assert logits.shape == (2, 16, 256)
+
+    labels = np.full((2, 16), -1, np.int32)
+    labels[0, 3] = 7
+    labels[1, 5] = 9
+    loss = masked_lm(logits, jnp.asarray(labels))
+    assert np.isfinite(float(loss)) and float(loss) > 0
+
+
+def test_masked_lm_ignores_unmasked_positions():
+    logits = jnp.asarray(np.zeros((1, 4, 8), np.float32))
+    labels = jnp.asarray(np.array([[-1, 2, -1, -1]], np.int32))
+    # uniform logits -> loss = log(8) over exactly one masked position
+    np.testing.assert_allclose(float(masked_lm(logits, labels)),
+                               np.log(8), rtol=1e-5)
+
+
+def test_vit_tiny_forward_and_grad():
+    model = vit_tiny()
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 16, 16, 3)),
+                    jnp.float32)
+    params = model.init(jax.random.key(0), x, train=False)["params"]
+    y = model.apply({"params": params}, x, train=False)
+    assert y.shape == (2, 10)
+
+    def loss(p):
+        return jnp.mean(model.apply({"params": p}, x, train=True) ** 2)
+
+    grads = jax.grad(loss)(params)
+    assert all(np.isfinite(float(jnp.linalg.norm(g)))
+               for g in jax.tree.leaves(grads))

@@ -1,0 +1,83 @@
+"""Transformer parity tests (reference transformers.py behavior)."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.transformers import (
+    DenseTransformer,
+    LabelIndexTransformer,
+    MinMaxTransformer,
+    OneHotTransformer,
+    Pipeline,
+    ReshapeTransformer,
+)
+
+
+def _ds(**cols):
+    return Dataset(cols)
+
+
+def test_minmax_explicit_range():
+    ds = _ds(features=np.array([[0.0, 128.0], [255.0, 64.0]], np.float32))
+    out = MinMaxTransformer(o_min=0.0, o_max=1.0, c_min=0.0, c_max=255.0
+                            ).transform(ds)
+    np.testing.assert_allclose(out["features"],
+                               [[0.0, 128 / 255], [1.0, 64 / 255]], atol=1e-6)
+
+
+def test_minmax_fitted_range_and_new_column():
+    ds = _ds(features=np.array([[1.0], [3.0]], np.float32))
+    out = MinMaxTransformer(o_min=-1.0, o_max=1.0,
+                            output_col="scaled").transform(ds)
+    np.testing.assert_allclose(out["scaled"], [[-1.0], [1.0]])
+    np.testing.assert_allclose(out["features"], [[1.0], [3.0]])  # untouched
+
+
+def test_dense_from_object_rows():
+    rows = np.empty(2, object)
+    rows[0] = [1.0, 2.0]
+    rows[1] = [3.0, 4.0]
+    out = DenseTransformer(input_col="features").transform(_ds(features=rows))
+    assert out["features"].shape == (2, 2)
+    assert out["features"].dtype == np.float32
+
+
+def test_onehot():
+    out = OneHotTransformer(4, input_col="label", output_col="enc"
+                            ).transform(_ds(label=np.array([0, 3, 1])))
+    np.testing.assert_array_equal(
+        out["enc"], np.eye(4, dtype=np.float32)[[0, 3, 1]])
+
+
+def test_onehot_range_check():
+    with pytest.raises(ValueError):
+        OneHotTransformer(2).transform(_ds(label=np.array([0, 5])))
+
+
+def test_reshape():
+    ds = _ds(features=np.arange(2 * 12, dtype=np.float32).reshape(2, 12))
+    out = ReshapeTransformer("features", "image", (2, 2, 3)).transform(ds)
+    assert out["image"].shape == (2, 2, 2, 3)
+
+
+def test_label_index_vector_and_binary():
+    vec = _ds(prediction=np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+    out = LabelIndexTransformer().transform(vec)
+    np.testing.assert_array_equal(out["predicted_index"], [1, 0])
+
+    binary = _ds(prediction=np.array([[0.6], [0.4]], np.float32))
+    out = LabelIndexTransformer().transform(binary)
+    np.testing.assert_array_equal(out["predicted_index"], [1, 0])
+
+
+def test_pipeline_composes():
+    ds = _ds(features=np.arange(8, dtype=np.float32).reshape(2, 4),
+             label=np.array([1, 0]))
+    pipe = Pipeline([
+        MinMaxTransformer(c_min=0.0, c_max=7.0),
+        OneHotTransformer(2, input_col="label", output_col="onehot"),
+    ])
+    out = pipe.transform(ds)
+    assert out["features"].max() <= 1.0
+    assert out["onehot"].shape == (2, 2)
