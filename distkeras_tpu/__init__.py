@@ -30,6 +30,7 @@ from distkeras_tpu.trainers import (
     DynSGD,
     EAMSGD,
     EnsembleTrainer,
+    PjitTrainer,
     SingleTrainer,
     Trainer,
 )
@@ -54,6 +55,7 @@ __all__ = [
     "ModelPredictor",
     "OneHotTransformer",
     "Pipeline",
+    "PjitTrainer",
     "Predictor",
     "ReshapeTransformer",
     "SingleTrainer",

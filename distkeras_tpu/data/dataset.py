@@ -58,9 +58,15 @@ class Dataset:
 
     # -- distribution-shaped ops -------------------------------------------
     def shuffle(self, seed: int = 0) -> "Dataset":
-        """utils.shuffle(df) parity, but deterministic by seed."""
+        """utils.shuffle(df) parity, but deterministic by seed. The row
+        gather runs through the native threaded assembler when available
+        (data/native.py); indices are identical either way, so numerics
+        do not depend on which path executed."""
+        from distkeras_tpu.data import native
+
         perm = rng.permutation(seed, len(self))
-        return Dataset({k: v[perm] for k, v in self._columns.items()})
+        return Dataset({k: native.gather_rows(v, perm)
+                        for k, v in self._columns.items()})
 
     def repartition(self, num_partitions: int) -> List["Dataset"]:
         """Split into contiguous near-equal shards (Spark repartition parity;

@@ -1,0 +1,106 @@
+"""ctypes binding for the native batch assembler (native/batcher.cc).
+
+Compiled on first use with g++ (cached under native/); every entry point
+falls back to NumPy when the toolchain or the .so is unavailable, so the
+framework never hard-depends on the native path — it is a throughput
+optimization for the host side of the input pipeline.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native", "batcher.cc")
+_SO = os.path.join(os.path.dirname(_SRC), "libdkbatch.so")
+
+
+def _build() -> Optional[str]:
+    try:
+        if os.path.exists(_SO) and (not os.path.exists(_SRC) or
+                                    os.path.getmtime(_SO) >=
+                                    os.path.getmtime(_SRC)):
+            return _SO
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC, "-lpthread"],
+            check=True, capture_output=True, timeout=120)
+        return _SO
+    except Exception:
+        return None
+
+
+def _lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        so = _build()
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+            lib.dk_gather_rows.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int32]
+            lib.dk_gather_rows.restype = None
+            lib.dk_permutation.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
+            lib.dk_permutation.restype = None
+            _LIB = lib
+        except OSError:
+            _LIB = None
+        return _LIB
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray,
+                num_threads: int = 0) -> np.ndarray:
+    """out[i] = src[idx[i]] — native threaded memcpy gather with numpy
+    fallback. src may have any row shape; idx is int64 [n]."""
+    lib = _lib()
+    idx = np.ascontiguousarray(idx, np.int64)
+    src = np.asarray(src)
+    if lib is None or src.dtype.hasobject:
+        # object rows are PyObject pointers — memcpy without incref corrupts
+        # the interpreter; those columns stay on the numpy path
+        return src[idx]
+    src = np.ascontiguousarray(src)
+    n = len(idx)
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+    out = np.empty((n,) + src.shape[1:], src.dtype)
+    if num_threads <= 0:
+        num_threads = min(8, os.cpu_count() or 1)
+    lib.dk_gather_rows(
+        src.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        idx.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(n), ctypes.c_int64(row_bytes),
+        ctypes.c_int32(num_threads))
+    return out
+
+
+def permutation(n: int, seed: int) -> np.ndarray:
+    """Deterministic Fisher-Yates permutation of [0, n); native xoshiro256**
+    with numpy fallback (NOTE: the two paths draw different sequences — both
+    deterministic by seed, but not bit-identical to each other)."""
+    lib = _lib()
+    if lib is None:
+        return np.random.default_rng(seed).permutation(n).astype(np.int64)
+    out = np.empty(n, np.int64)
+    lib.dk_permutation(out.ctypes.data_as(ctypes.c_void_p),
+                       ctypes.c_int64(n), ctypes.c_uint64(seed & (2**64 - 1)))
+    return out

@@ -33,7 +33,7 @@ def _trainers() -> dict:
             name: getattr(t, name)
             for name in ("SingleTrainer", "AveragingTrainer",
                          "EnsembleTrainer", "DOWNPOUR", "ADAG", "DynSGD",
-                         "AEASGD", "EAMSGD")
+                         "AEASGD", "EAMSGD", "PjitTrainer")
         }
     return _TRAINER_REGISTRY
 

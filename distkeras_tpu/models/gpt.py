@@ -1,0 +1,117 @@
+"""Causal LM — the long-context model family (sequence parallelism ready).
+
+No reference parity (dist-keras predates transformers; SURVEY.md §5 marks
+long-context ABSENT) — this is the framework's first-class long-context
+story: a GPT-style decoder whose attention can run either
+
+- ``attention="full"``: single-device causal attention, or
+- ``attention="ring"``: ring attention over a ``seq`` mesh axis
+  (ops/ring_attention.py) — the module then operates on the LOCAL sequence
+  block inside ``shard_map``, with global positions derived from
+  ``jax.lax.axis_index``; peak memory per device drops from O(T^2) to
+  O((T/P)^2) and k/v blocks ride the ICI ring.
+
+Both paths share weights: a model trained sequence-parallel serves
+single-device and vice versa.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.models.transformer import MlpBlock
+from distkeras_tpu.ops.attention import dot_product_attention
+from distkeras_tpu.ops.ring_attention import ring_attention
+
+
+class CausalSelfAttention(nn.Module):
+    num_heads: int
+    dtype: jnp.dtype = jnp.bfloat16
+    attention: str = "full"  # or "ring"
+    axis_name: str = "seq"
+
+    @nn.compact
+    def __call__(self, x):
+        width = x.shape[-1]
+        head_dim = width // self.num_heads
+        qkv = nn.Dense(3 * width, dtype=self.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        split = lambda t: t.reshape(t.shape[:2] + (self.num_heads, head_dim))
+        q, k, v = split(q), split(k), split(v)
+        if self.attention == "ring":
+            out = ring_attention(q, k, v, axis_name=self.axis_name,
+                                 causal=True)
+        else:
+            out = dot_product_attention(q, k, v, causal=True)
+        out = out.reshape(out.shape[:2] + (width,))
+        return nn.Dense(width, dtype=self.dtype, name="out")(out)
+
+
+class DecoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+    attention: str = "full"
+    axis_name: str = "seq"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(self.dtype)
+        y = CausalSelfAttention(self.num_heads, self.dtype, self.attention,
+                                self.axis_name, name="attn")(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
+        y = MlpBlock(self.mlp_dim, 0.0, self.dtype, name="mlp")(y, train=train)
+        return x + y
+
+
+class CausalLM(nn.Module):
+    vocab_size: int = 32000
+    max_len: int = 2048
+    num_layers: int = 12
+    num_heads: int = 12
+    width: int = 768
+    mlp_dim: int = 3072
+    dtype: jnp.dtype = jnp.bfloat16
+    attention: str = "full"
+    axis_name: str = "seq"
+
+    @nn.compact
+    def __call__(self, input_ids, train: bool = False):
+        ids = input_ids.astype(jnp.int32)
+        b, t = ids.shape  # t = LOCAL block length under sequence parallelism
+        x = nn.Embed(self.vocab_size, self.width, dtype=self.dtype,
+                     name="tok_embed")(ids)
+        pos_table = self.param("pos_embed", nn.initializers.normal(0.02),
+                               (self.max_len, self.width))
+        if self.attention == "ring":
+            # global positions of this device's block
+            offset = jax.lax.axis_index(self.axis_name) * t
+            pos = jax.lax.dynamic_slice_in_dim(pos_table, offset, t)
+        else:
+            pos = pos_table[:t]
+        x = x + pos.astype(self.dtype)
+        for i in range(self.num_layers):
+            x = DecoderBlock(self.num_heads, self.mlp_dim, self.dtype,
+                             self.attention, self.axis_name,
+                             name=f"layer_{i}")(x, train=train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        logits = nn.Dense(self.vocab_size, dtype=jnp.float32,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def gpt_small(**kw) -> CausalLM:
+    """GPT-2-small shape (124M)."""
+    return CausalLM(vocab_size=50304, max_len=1024, num_layers=12,
+                    num_heads=12, width=768, mlp_dim=3072, **kw)
+
+
+def gpt_tiny(**kw) -> CausalLM:
+    """Test-sized causal LM."""
+    defaults = dict(vocab_size=256, max_len=128, num_layers=2, num_heads=2,
+                    width=32, mlp_dim=64, dtype=jnp.float32)
+    defaults.update(kw)
+    return CausalLM(**defaults)

@@ -62,8 +62,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     o0 = jnp.zeros((b, tq, h, d), jnp.float32)
     q_pos = idx * tq + jnp.arange(tq)
 
-    def body(carry, step):
-        m, l, o, k, v, kv_mask = carry
+    def fold_block(step, m, l, o, k, v, kv_mask):
         src = (idx - step) % num_blocks  # which global block we hold now
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
         logits = logits * scale
@@ -73,7 +72,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             logits = jnp.where(allowed[None, None], logits, -jnp.inf)
         if kv_mask is not None:
             logits = jnp.where(kv_mask[:, None, None, :], logits, -jnp.inf)
-        m, l, o = _block_update(logits, m, l, o, v)
+        return _block_update(logits, m, l, o, v)
+
+    def body(carry, step):
+        m, l, o, k, v, kv_mask = carry
+        m, l, o = fold_block(step, m, l, o, k, v, kv_mask)
         perm = [(i, (i + 1) % num_blocks) for i in range(num_blocks)]
         k = jax.lax.ppermute(k, axis_name, perm)
         v = jax.lax.ppermute(v, axis_name, perm)
@@ -81,9 +84,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             kv_mask = jax.lax.ppermute(kv_mask, axis_name, perm)
         return (m, l, o, k, v, kv_mask), None
 
-    (m, l, o, _, _, _), _ = jax.lax.scan(
+    # scan the first P-1 hops (each ends with a permute), then fold the last
+    # block WITHOUT the wrap-around permute — that final hop's k/v would be
+    # discarded, and inside scan XLA cannot elide the dead collective
+    (m, l, o, k, v, kv_mask), _ = jax.lax.scan(
         body, (m0, l0, o0, k, v, kv_mask),
-        jnp.arange(num_blocks, dtype=jnp.int32))
+        jnp.arange(num_blocks - 1, dtype=jnp.int32))
+    m, l, o = fold_block(jnp.int32(num_blocks - 1), m, l, o, k, v, kv_mask)
     l_o = l.transpose(0, 2, 1)[..., None]               # [B,Tq,H,1]
     out = jnp.where(l_o > 0, o / jnp.maximum(l_o, 1e-30), 0.0)
     return out.astype(q.dtype)

@@ -1,0 +1,125 @@
+"""Sequence/context parallelism: the long-context training substrate.
+
+Splits the SEQUENCE dimension of a causal LM over a ``seq`` mesh axis
+(ring attention moves k/v blocks around the ring; ops/ring_attention.py) and
+the batch over ``workers`` — composable data x context parallelism. Gradients
+are psum'd over both axes; the loss is the exact global-mean token loss, so
+an (w x s) step equals the single-device step on the same global batch.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu import engine
+from distkeras_tpu.parallel import mesh as mesh_lib
+
+SEQ_AXIS = "seq"
+
+
+def make_sp_mesh(num_workers: int = 1, seq_parallelism: int = 1,
+                 devices=None) -> Mesh:
+    """(workers, seq) mesh: batch parallelism outer, sequence inner (adjacent
+    devices share the ring, so k/v hops ride the shortest ICI links)."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = num_workers * seq_parallelism
+    if need > len(devices):
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    grid = np.asarray(devices[:need]).reshape(num_workers, seq_parallelism)
+    return Mesh(grid, (mesh_lib.WORKER_AXIS, SEQ_AXIS))
+
+
+def shift_labels(input_ids: np.ndarray, pad_to_ignore: bool = True) -> np.ndarray:
+    """Host-side next-token labels: labels[t] = ids[t+1]; final position
+    ignored (-1). Done globally BEFORE sequence sharding so block boundaries
+    need no device-to-device shift."""
+    labels = np.full_like(np.asarray(input_ids), -1)
+    labels[:, :-1] = input_ids[:, 1:]
+    return labels
+
+
+def build_sp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
+                        donate: bool = True):
+    """Compiled sequence-parallel LM train step.
+
+    Returns ``(step_fn, place_state, place_batch)``:
+    - ``step_fn(state, batch) -> (state, metrics)`` where batch is
+      ``{"input_ids": [B, T], "labels": [B, T]}`` int32 arrays; B sharded
+      over ``workers``, T over ``seq``; labels < 0 are ignored.
+    - metrics: global mean ``loss`` and token ``accuracy``.
+
+    The model must be built with ``attention="ring", axis_name="seq"``.
+    """
+
+    def local_step(params, opt_state, step_i, input_ids, labels):
+        def loss_sum(p):
+            logits = model.apply({"params": p}, input_ids, train=True)
+            valid = labels >= 0
+            safe = jnp.where(valid, labels, 0).astype(jnp.int32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+            nll = -jnp.sum(jnp.where(valid, ll, 0.0))
+            hits = jnp.sum(jnp.where(
+                valid, (jnp.argmax(logits, -1) == safe), False))
+            count = jnp.sum(valid)
+            return nll, (hits, count)
+
+        (nll, (hits, count)), grads = jax.value_and_grad(
+            loss_sum, has_aux=True)(params)
+        axes = (mesh_lib.WORKER_AXIS, SEQ_AXIS)
+        total_nll = jax.lax.psum(nll, axes)
+        total_hits = jax.lax.psum(hits.astype(jnp.float32), axes)
+        total_count = jnp.maximum(
+            jax.lax.psum(count.astype(jnp.float32), axes), 1.0)
+        grads = jax.lax.psum(grads, axes)
+        grads = jax.tree.map(lambda g: g / total_count, grads)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        ms = {"loss": total_nll / total_count,
+              "accuracy": total_hits / total_count}
+        return params, opt_state, step_i + 1, ms
+
+    data_spec = P(mesh_lib.WORKER_AXIS, SEQ_AXIS)
+    shmapped = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(), P(), P(), data_spec, data_spec),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False)
+
+    def step_fn(state: engine.TrainState, batch) -> Tuple[engine.TrainState, dict]:
+        params, opt_state, step_i, ms = jitted(
+            state.params, state.opt_state, state.step,
+            batch["input_ids"], batch["labels"])
+        return engine.TrainState(step=step_i, params=params,
+                                 opt_state=opt_state), ms
+
+    jitted = jax.jit(shmapped, donate_argnums=(0, 1) if donate else ())
+
+    def place_state(state):
+        return jax.device_put(state, NamedSharding(mesh, P()))
+
+    def place_batch(batch):
+        return jax.device_put(batch, NamedSharding(mesh, data_spec))
+
+    return step_fn, place_state, place_batch
+
+
+def init_sp_state(model, tx, mesh, batch_shape: Tuple[int, int],
+                  seed: int = 0) -> engine.TrainState:
+    """Init params OUTSIDE shard_map with full-attention semantics (weights
+    are shared between attention impls), replicated on the mesh."""
+    b, t_local = batch_shape
+    # a full-attention twin with identical params for shape-only init
+    twin = model.clone(attention="full")
+    params = twin.init(jax.random.key(seed),
+                       jnp.zeros((b, t_local), jnp.int32),
+                       train=False)["params"]
+    state = engine.TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                              opt_state=tx.init(params))
+    return jax.device_put(state, NamedSharding(mesh, P()))

@@ -1,0 +1,172 @@
+"""Tensor parallelism: param partition rules + GSPMD train step.
+
+Not a reference-parity obligation (dist-keras has no TP — SURVEY.md §2), but
+a first-class capability of this framework: BASELINE config 5 names
+"pjit-sharded data-parallel" for ViT-L, and large transformer models need
+their matmuls split over the ``model`` mesh axis.
+
+Design (the scaling-book recipe): pick a mesh (workers × model), annotate
+param shardings by PATH RULES (regex -> PartitionSpec), shard the batch over
+``workers``, jit, and let GSPMD insert the collectives (all-reduce of grads
+over workers, all-gather/reduce-scatter around the model-sharded matmuls).
+No hand-written collectives on this path at all.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu import engine
+from distkeras_tpu.parallel import mesh as mesh_lib
+
+Rules = Sequence[Tuple[str, P]]
+
+# Default rules for the in-tree model zoo (transformer + conv families).
+# First match wins; unmatched params replicate. Megatron-style pairing:
+# column-parallel into the nonlinearity, row-parallel out of it.
+DEFAULT_RULES: Rules = (
+    (r"attn/qkv/kernel$", P(None, mesh_lib.MODEL_AXIS)),
+    (r"attn/out/kernel$", P(mesh_lib.MODEL_AXIS, None)),
+    (r"mlp/fc1/kernel$", P(None, mesh_lib.MODEL_AXIS)),
+    (r"mlp/fc2/kernel$", P(mesh_lib.MODEL_AXIS, None)),
+    (r"tok_embed/embedding$", P(mesh_lib.MODEL_AXIS, None)),  # vocab-sharded
+    (r"mlm_head/kernel$", P(None, mesh_lib.MODEL_AXIS)),
+    (r"head/kernel$", P(None, mesh_lib.MODEL_AXIS)),
+    (r"dense.*/kernel$", P(None, mesh_lib.MODEL_AXIS)),
+)
+
+
+def path_str(path) -> str:
+    """jax tree path -> 'a/b/c' string for rule matching."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def partition_specs(params: Any, rules: Optional[Rules] = None,
+                    mesh: Optional[Mesh] = None) -> Any:
+    """PartitionSpec pytree for ``params`` by first-match path rules.
+
+    A matched spec is kept only if every named axis divides the corresponding
+    param dimension (tiny test models fall back to replication rather than
+    erroring out).
+    """
+    rules = DEFAULT_RULES if rules is None else tuple(rules)
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+    axis_sizes = dict(mesh.shape) if mesh is not None else {}
+
+    def spec_for(path, leaf):
+        name = path_str(path)
+        for pat, spec in compiled:
+            if pat.search(name):
+                if len(spec) > np.ndim(leaf):
+                    return P()
+                for dim, axis in enumerate(spec):
+                    if axis is None:
+                        continue
+                    size = axis_sizes.get(axis)
+                    if size and np.shape(leaf)[dim] % size != 0:
+                        return P()  # indivisible: replicate instead
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def shard_params(params: Any, mesh: Mesh,
+                 rules: Optional[Rules] = None) -> Any:
+    """Place ``params`` on the mesh according to the rules."""
+    specs = partition_specs(params, rules, mesh)
+    return jax.device_put(
+        params, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P)))
+
+
+def build_pjit_epoch_fn(model, loss, tx: optax.GradientTransformation,
+                        mesh: Mesh, metrics: Sequence[str] = (),
+                        rules: Optional[Rules] = None,
+                        dropout_seed: int = 0):
+    """Sync data-parallel (× tensor-parallel) epoch: scan over staged steps.
+
+    Returns ``(epoch_fn, place_state, place_data)``:
+    - ``epoch_fn(state, data, step_offset) -> (state, metrics)`` — jitted,
+      state donated; ``data`` leaves are [steps, batch, ...] with batch
+      sharded over ``workers``.
+    - ``place_state(state)`` / ``place_data(data)`` put pytrees on the mesh
+      with the matching shardings.
+
+    This is the honest sync-DP fast path (BASELINE config 5): one compiled
+    program, grads all-reduced by GSPMD, params optionally model-sharded.
+    """
+    grad_fn = engine.make_grad_fn(model, loss)
+    metric_names = tuple(metrics)
+    base_key = jax.random.key(dropout_seed)
+
+    def epoch(state, data, step_offset):
+        def one_step(st, xs):
+            batch, i = xs
+            rng = jax.random.fold_in(base_key, step_offset + i)
+            (loss_val, logits), grads = grad_fn(st.params, batch,
+                                                {"dropout": rng})
+            updates, opt_state = tx.update(grads, st.opt_state, st.params)
+            params = optax.apply_updates(st.params, updates)
+            out = {"loss": loss_val}
+            for name in metric_names:
+                out[name] = engine.compute_metric(name, logits,
+                                                  batch["labels"])
+            return engine.TrainState(step=st.step + 1, params=params,
+                                     opt_state=opt_state), out
+
+        steps = jax.tree.leaves(data)[0].shape[0]
+        idx = jnp.arange(steps, dtype=jnp.int32)
+        return jax.lax.scan(one_step, state, (data, idx))
+
+    data_sharding = NamedSharding(mesh, P(None, mesh_lib.WORKER_AXIS))
+
+    def place_state(state):
+        return engine.TrainState(
+            step=jax.device_put(state.step, NamedSharding(mesh, P())),
+            params=shard_params(state.params, mesh, rules),
+            opt_state=jax.device_put(
+                state.opt_state,
+                jax.tree.map(lambda _: NamedSharding(mesh, P()),
+                             state.opt_state)))
+
+    def place_data(data):
+        return jax.device_put(data, data_sharding)
+
+    epoch_fn = jax.jit(epoch, donate_argnums=(0,))
+    return epoch_fn, place_state, place_data
+
+
+def stage_steps(dataset, features_col: str, label_col: str, batch_size: int,
+                max_steps: Optional[int] = None) -> tuple:
+    """[steps, batch, ...] arrays from a Dataset (global batch; the mesh
+    shards the batch dim over workers at device_put)."""
+    n = len(dataset)
+    steps = n // batch_size
+    if max_steps is not None:
+        steps = min(steps, max_steps)
+    if steps == 0:
+        raise ValueError(f"{n} rows cannot form one batch of {batch_size}")
+    cut = steps * batch_size
+
+    def stack(col):
+        arr = np.asarray(dataset[col][:cut])
+        return arr.reshape((steps, batch_size) + arr.shape[1:])
+
+    return {"features": stack(features_col),
+            "labels": stack(label_col)}, steps

@@ -43,7 +43,8 @@ class Trainer:
                  learning_rate: float = 0.01,
                  metrics: Sequence[str] = ("accuracy",),
                  features_col: str = "features", label_col: str = "label",
-                 batch_size: int = 32, num_epoch: int = 1, seed: int = 0):
+                 batch_size: int = 32, num_epoch: int = 1, seed: int = 0,
+                 checkpoint_dir: Optional[str] = None):
         self.model = model
         self.loss = loss
         self.worker_optimizer = worker_optimizer
@@ -54,12 +55,31 @@ class Trainer:
         self.batch_size = int(batch_size)
         self.num_epoch = int(num_epoch)
         self.seed = int(seed)
+        self.checkpoint_dir = checkpoint_dir
 
         self.tx = opt_lib.get(worker_optimizer, learning_rate)
         losses_lib.get(loss)  # fail fast on unknown loss names
         self.params = None
         self.history: list[dict] = []
         self.training_time: float = 0.0
+
+    # -- checkpointing (per-epoch; the reference had NONE — SURVEY.md §5) ---
+    def _checkpointer(self):
+        if self.checkpoint_dir is None:
+            return None
+        from distkeras_tpu.checkpoint import Checkpointer
+
+        return Checkpointer(self.checkpoint_dir)
+
+    @staticmethod
+    def _maybe_resume(ckpt, like: dict, resume: bool) -> tuple:
+        """(state_dict, start_epoch): restore the latest epoch checkpoint if
+        asked and present. History is NOT checkpointed — a resumed trainer's
+        history covers only the epochs it ran."""
+        if ckpt is None or not resume or ckpt.latest_step() is None:
+            return like, 0
+        step = ckpt.latest_step()
+        return ckpt.restore(like=like), step + 1
 
     # -- bookkeeping (record_training_time parity) -------------------------
     def _start(self):
@@ -130,10 +150,11 @@ class DistributedTrainer(Trainer):
                  communication_window: int = 5,
                  master_port: Optional[int] = None,  # parity no-op
                  mesh=None, seed: int = 0, mode: str = "sync",
+                 checkpoint_dir: Optional[str] = None,
                  **strategy_kwargs):
         super().__init__(model, loss, worker_optimizer, learning_rate,
                          metrics, features_col, label_col, batch_size,
-                         num_epoch, seed)
+                         num_epoch, seed, checkpoint_dir=checkpoint_dir)
         from distkeras_tpu.parallel import mesh as mesh_lib
 
         if mode not in ("sync", "host_async"):
@@ -195,25 +216,35 @@ class DistributedTrainer(Trainer):
         state = self._init_params(dataset)
         return self._init_carries(state.params)
 
-    def train(self, dataset: Dataset, shuffle: bool = False):
+    def train(self, dataset: Dataset, shuffle: bool = False,
+              resume: bool = False):
         from distkeras_tpu.parallel import substrate
 
         if self.mode == "host_async":
+            if self.checkpoint_dir is not None:
+                raise ValueError(
+                    "checkpoint_dir is not supported in host_async mode "
+                    "(no epoch barrier to snapshot at); use mode='sync'")
             return self._train_host_async(dataset, shuffle)
         self._start()
         self._check_trainable(
             dataset, self.batch_size * self.communication_window * self.num_workers)
         center, carries = self._setup_state(dataset)
+        ckpt = self._checkpointer()
+        snap, start_epoch = self._maybe_resume(
+            ckpt, {"center": center, "carries": carries,
+                   "counters": np.zeros((2,), np.int64)}, resume)
+        center, carries = snap["center"], snap["carries"]
         epoch_fn = substrate.build_epoch_fn(
             self.model, self.loss, self.tx, self.strategy, self.mesh,
             self.num_workers, self.communication_window, self.metrics,
             dropout_seed=self.seed)
         self.history = []
         self.staleness_history = []
-        self.num_updates = 0
-        round_offset = 0
+        round_offset = int(np.asarray(snap["counters"])[0])
+        self.num_updates = int(np.asarray(snap["counters"])[1])
         staged = None  # shuffle=False: stage the (identical) epoch data once
-        for epoch in range(self.num_epoch):
+        for epoch in range(start_epoch, self.num_epoch):
             if shuffle or staged is None:
                 ds = dataset.shuffle(self.seed + epoch) if shuffle else dataset
                 staged = substrate.stage_epoch_data(
@@ -225,6 +256,14 @@ class DistributedTrainer(Trainer):
                                            np.int32(round_offset))
             round_offset += rounds
             self._record(jax.device_get(ms), rounds)
+            if ckpt is not None:
+                ckpt.save(epoch, {"center": center, "carries": carries,
+                                  "counters": np.array(
+                                      [round_offset, self.num_updates],
+                                      np.int64)})
+        if ckpt is not None:
+            ckpt.wait()
+            ckpt.close()
         self.params = self._finalize(center, carries)
         self._stop()
         return self.params
@@ -350,25 +389,111 @@ class EnsembleTrainer(DistributedTrainer):
                 for i in range(self.num_workers)]
 
 
+class PjitTrainer(Trainer):
+    """Sync data-parallel (× tensor-parallel) trainer on the GSPMD path.
+
+    BASELINE config 5 ("pjit-sharded data-parallel", ViT-L): the batch is
+    sharded over the ``workers`` mesh axis, params optionally over ``model``
+    via partition rules (parallel/tensor.py), and XLA inserts every
+    collective. This is the throughput-first sync alternative to the async
+    zoo — no parameter server semantics, just compiled SPMD.
+    """
+
+    def __init__(self, model, loss="categorical_crossentropy",
+                 worker_optimizer="sgd", learning_rate: float = 0.01,
+                 metrics=("accuracy",), features_col="features",
+                 label_col="label", batch_size: int = 32, num_epoch: int = 1,
+                 num_workers: Optional[int] = None,
+                 model_parallelism: int = 1, partition_rules=None,
+                 mesh=None, seed: int = 0,
+                 checkpoint_dir: Optional[str] = None):
+        super().__init__(model, loss, worker_optimizer, learning_rate,
+                         metrics, features_col, label_col, batch_size,
+                         num_epoch, seed, checkpoint_dir=checkpoint_dir)
+        from distkeras_tpu.parallel import mesh as mesh_lib
+
+        self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(
+            num_workers, model_parallelism=model_parallelism)
+        self.num_workers = self.mesh.shape[mesh_lib.WORKER_AXIS]
+        self.partition_rules = partition_rules
+        if self.batch_size % self.num_workers != 0:
+            raise ValueError(
+                f"batch_size {self.batch_size} must be divisible by "
+                f"num_workers {self.num_workers} (the batch is the GLOBAL "
+                f"batch, sharded over the workers axis)")
+
+    def train(self, dataset: Dataset, shuffle: bool = False,
+              resume: bool = False):
+        from distkeras_tpu.parallel import tensor
+
+        self._start()
+        self._check_trainable(dataset, self.batch_size)
+        state = self._init_params(dataset)
+        epoch_fn, place_state, place_data = tensor.build_pjit_epoch_fn(
+            self.model, self.loss, self.tx, self.mesh, self.metrics,
+            self.partition_rules, dropout_seed=self.seed)
+        state = place_state(state)
+        ckpt = self._checkpointer()
+        snap, start_epoch = self._maybe_resume(
+            ckpt, {"state": state, "counters": np.zeros((1,), np.int64)},
+            resume)
+        state = snap["state"]
+        self.history = []
+        staged = None
+        step_offset = int(np.asarray(snap["counters"])[0])
+        for epoch in range(start_epoch, self.num_epoch):
+            if shuffle or staged is None:
+                ds = dataset.shuffle(self.seed + epoch) if shuffle else dataset
+                data, steps = tensor.stage_steps(
+                    ds, self.features_col, self.label_col, self.batch_size)
+                staged = (place_data(data), steps)
+            data, steps = staged
+            state, ms = epoch_fn(state, data, np.int32(step_offset))
+            step_offset += steps
+            host = jax.device_get(ms)
+            self.history.extend(
+                {k: float(v[i]) for k, v in host.items()}
+                for i in range(steps))
+            if ckpt is not None:
+                ckpt.save(epoch, {"state": state,
+                                  "counters": np.array([step_offset],
+                                                       np.int64)})
+        if ckpt is not None:
+            ckpt.wait()
+            ckpt.close()
+        self.params = jax.device_get(state.params)
+        self._stop()
+        return self.params
+
+
 class SingleTrainer(Trainer):
     """One replica, plain minibatch SGD — the reference's minimum slice
     (SingleTrainer: coalesce to one partition, train locally)."""
 
-    def train(self, dataset: Dataset, shuffle: bool = False):
+    def train(self, dataset: Dataset, shuffle: bool = False,
+              resume: bool = False):
         self._start()
         if shuffle:
             dataset = dataset.shuffle(self.seed)
         self._check_trainable(dataset, self.batch_size)
         state = self._init_params(dataset)
+        ckpt = self._checkpointer()
+        snap, start_epoch = self._maybe_resume(ckpt, {"state": state}, resume)
+        state = snap["state"]
         step_fn = engine.make_train_step(self.model, self.loss, self.tx,
                                          metrics=self.metrics,
                                          dropout_seed=self.seed)
         device_history = []  # device arrays; fetched once at the end so the
-        for epoch in range(self.num_epoch):  # hot loop never blocks on host
+        for epoch in range(start_epoch, self.num_epoch):  # hot loop stays on device
             for raw in dataset.batches(self.batch_size,
                                        cols=[self.features_col, self.label_col]):
                 state, m = step_fn(state, self._batch_dict(raw))
                 device_history.append(m)
+            if ckpt is not None:
+                ckpt.save(epoch, {"state": state})
+        if ckpt is not None:
+            ckpt.wait()
+            ckpt.close()
         self.history = [{k: float(v) for k, v in h.items()}
                         for h in jax.device_get(device_history)]
         self.params = jax.device_get(state.params)

@@ -1,0 +1,98 @@
+// Native batch assembler — the data-pipeline hot path in C++.
+//
+// The reference's per-row Spark iterators assembled minibatches in Python
+// (distkeras/workers.py row loop — unverified, mount empty); at TPU rates the
+// equivalent numpy fancy-indexing gather can become the host-side bottleneck
+// that starves the MXU. This library does the two hot jobs with raw memcpy
+// and a thread pool:
+//
+//   dk_gather_rows:  out[i] = src[idx[i]]  (row gather, arbitrary row size)
+//   dk_permute_inplace_u32: Fisher-Yates permutation generation (xoshiro256**)
+//
+// Exposed with a minimal C ABI for ctypes (no pybind11 in this image).
+// Build: g++ -O3 -march=native -shared -fPIC -o libdkbatch.so batcher.cc -lpthread
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Gather rows: dst[i*row_bytes : (i+1)*row_bytes] = src[idx[i]*row_bytes : ...]
+// Parallelized over rows with a simple thread pool when the copy is large.
+void dk_gather_rows(const uint8_t* src, uint8_t* dst, const int64_t* idx,
+                    int64_t num_rows, int64_t row_bytes, int32_t num_threads) {
+  if (num_threads < 1) num_threads = 1;
+  const int64_t total = num_rows * row_bytes;
+  if (num_threads == 1 || total < (int64_t)1 << 20) {
+    for (int64_t i = 0; i < num_rows; ++i) {
+      std::memcpy(dst + i * row_bytes, src + idx[i] * row_bytes, row_bytes);
+    }
+    return;
+  }
+  std::vector<std::thread> threads;
+  const int64_t chunk = (num_rows + num_threads - 1) / num_threads;
+  for (int32_t t = 0; t < num_threads; ++t) {
+    const int64_t lo = t * chunk;
+    const int64_t hi = lo + chunk < num_rows ? lo + chunk : num_rows;
+    if (lo >= hi) break;
+    threads.emplace_back([=] {
+      for (int64_t i = lo; i < hi; ++i) {
+        std::memcpy(dst + i * row_bytes, src + idx[i] * row_bytes, row_bytes);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+}
+
+// xoshiro256** — public-domain PRNG (Blackman & Vigna), deterministic by seed.
+static inline uint64_t rotl(uint64_t x, int k) {
+  return (x << k) | (x >> (64 - k));
+}
+
+struct Xo256 {
+  uint64_t s[4];
+  explicit Xo256(uint64_t seed) {
+    // splitmix64 seeding
+    for (int i = 0; i < 4; ++i) {
+      seed += 0x9e3779b97f4a7c15ULL;
+      uint64_t z = seed;
+      z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+      z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+      s[i] = z ^ (z >> 31);
+    }
+  }
+  uint64_t next() {
+    const uint64_t result = rotl(s[1] * 5, 7) * 9;
+    const uint64_t t = s[1] << 17;
+    s[2] ^= s[0];
+    s[3] ^= s[1];
+    s[1] ^= s[2];
+    s[0] ^= s[3];
+    s[2] ^= t;
+    s[3] = rotl(s[3], 45);
+    return result;
+  }
+};
+
+// Write a Fisher-Yates permutation of [0, n) into out (int64), seeded.
+void dk_permutation(int64_t* out, int64_t n, uint64_t seed) {
+  for (int64_t i = 0; i < n; ++i) out[i] = i;
+  Xo256 rng(seed);
+  for (int64_t i = n - 1; i > 0; --i) {
+    // unbiased bounded draw (rejection sampling on the top bits)
+    uint64_t bound = (uint64_t)i + 1;
+    uint64_t threshold = (0 - bound) % bound;
+    uint64_t r;
+    do {
+      r = rng.next();
+    } while (r < threshold);
+    int64_t j = (int64_t)(r % bound);
+    int64_t tmp = out[i];
+    out[i] = out[j];
+    out[j] = tmp;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,93 @@
+"""Tensor-parallel path: partition rules, sharded training, dp x tp."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distkeras_tpu import PjitTrainer, synthetic_mnist
+from distkeras_tpu.models.mlp import MLP
+from distkeras_tpu.models.vit import vit_tiny
+from distkeras_tpu.parallel import mesh as mesh_lib
+from distkeras_tpu.parallel import tensor
+
+
+def test_partition_specs_rules_and_divisibility():
+    params = {
+        "encoder": {"layer_0": {"attn": {"qkv": {"kernel": np.zeros((64, 192))},
+                                         "out": {"kernel": np.zeros((64, 64))}},
+                    "mlp": {"fc1": {"kernel": np.zeros((64, 128))},
+                            "fc2": {"kernel": np.zeros((128, 64))}}}},
+        "head": {"kernel": np.zeros((64, 10)), "bias": np.zeros((10,))},
+    }
+    mesh = mesh_lib.make_mesh(num_workers=4, model_parallelism=2)
+    specs = tensor.partition_specs(params, mesh=mesh)
+    enc = specs["encoder"]["layer_0"]
+    assert enc["attn"]["qkv"]["kernel"] == P(None, "model")
+    assert enc["attn"]["out"]["kernel"] == P("model", None)
+    assert enc["mlp"]["fc1"]["kernel"] == P(None, "model")
+    assert enc["mlp"]["fc2"]["kernel"] == P("model", None)
+    assert specs["head"]["kernel"] == P(None, "model")
+    assert specs["head"]["bias"] == P()
+    # indivisible dim falls back to replication: 10 % 4 != 0
+    mesh4 = mesh_lib.make_mesh(num_workers=2, model_parallelism=4)
+    specs4 = tensor.partition_specs({"head": {"kernel": np.zeros((64, 10))}},
+                                    mesh=mesh4)
+    assert specs4["head"]["kernel"] == P()
+
+
+def test_pjit_trainer_mlp_converges_dp():
+    ds = synthetic_mnist(n=2048)
+    t = PjitTrainer(MLP(features=(64,), num_classes=10),
+                    worker_optimizer="momentum", learning_rate=0.1,
+                    batch_size=256, num_workers=8, num_epoch=4)
+    params = t.train(ds, shuffle=True)
+    h = t.get_history()
+    assert h[-1]["loss"] < h[0]["loss"] * 0.5
+    assert params is not None
+
+
+def test_pjit_trainer_matches_single_device_math():
+    """dp=8 pjit == single-device sequential SGD on the same global batches
+    (sync data parallelism is exact, unlike the async zoo)."""
+    from distkeras_tpu import SingleTrainer
+
+    ds = synthetic_mnist(n=512)
+    kw = dict(worker_optimizer="sgd", learning_rate=0.1, batch_size=64,
+              num_epoch=1, seed=3)
+    model = MLP(features=(32,), num_classes=10, dropout_rate=0.0)
+    tp = PjitTrainer(model, num_workers=8, **kw)
+    p1 = tp.train(ds)
+    ts = SingleTrainer(model, **kw)
+    p2 = ts.train(ds)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_pjit_trainer_vit_tp():
+    """ViT-tiny with dp=2 x tp=4: model-sharded matmuls + data parallelism."""
+    rng = np.random.default_rng(0)
+    from distkeras_tpu import Dataset
+
+    x = rng.standard_normal((256, 16, 16, 3)).astype(np.float32)
+    y = rng.integers(0, 10, 256)
+    ds = Dataset({"features": x,
+                  "label": np.eye(10, dtype=np.float32)[y]})
+    model = vit_tiny(width=64, num_heads=2, mlp_dim=128)
+    t = PjitTrainer(model, worker_optimizer="adam", learning_rate=1e-3,
+                    batch_size=32, num_workers=2, model_parallelism=4,
+                    num_epoch=2)
+    params = t.train(ds)
+    assert np.all(np.isfinite([h["loss"] for h in t.get_history()]))
+    # params sharded over the model axis actually happened
+    specs = tensor.partition_specs(params, mesh=t.mesh)
+    flat = jax.tree_util.tree_leaves_with_path(specs,
+                                               is_leaf=lambda x: isinstance(x, type(P())))
+    assert any(s == P(None, "model") for _, s in flat)
+
+
+def test_pjit_batch_divisibility_check():
+    with pytest.raises(ValueError, match="divisible"):
+        PjitTrainer(MLP(), batch_size=30, num_workers=8)

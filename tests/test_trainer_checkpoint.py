@@ -1,0 +1,84 @@
+"""Mid-training checkpoint/resume through the trainer API (fault-tolerance
+parity: the reference's story was Spark task retry; ours is
+restart-from-checkpoint — SURVEY.md §5)."""
+
+import jax
+import numpy as np
+import pytest
+
+from distkeras_tpu import ADAG, PjitTrainer, SingleTrainer, synthetic_mnist
+from distkeras_tpu.models.mlp import MLP
+
+
+def _model():
+    return MLP(features=(16,), num_classes=10)
+
+
+def _params_equal(a, b, rtol=1e-6):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=rtol,
+                                   atol=1e-6)
+
+
+def test_single_trainer_resume_matches_uninterrupted(tmp_path):
+    ds = synthetic_mnist(n=512)
+    kw = dict(worker_optimizer="sgd", learning_rate=0.05, batch_size=64,
+              seed=1)
+
+    full = SingleTrainer(_model(), num_epoch=4, **kw)
+    p_full = full.train(ds)
+
+    # epochs 0-1 with checkpointing, then a "crashed" trainer resumes 2-3
+    first = SingleTrainer(_model(), num_epoch=2,
+                          checkpoint_dir=str(tmp_path / "a"), **kw)
+    first.train(ds)
+    second = SingleTrainer(_model(), num_epoch=4,
+                           checkpoint_dir=str(tmp_path / "a"), **kw)
+    p_resumed = second.train(ds, resume=True)
+    _params_equal(p_full, p_resumed)
+    # resumed run only executed epochs 2-3
+    assert len(second.get_history()) == 2 * (512 // 64)
+
+
+def test_adag_resume_matches_uninterrupted(tmp_path):
+    ds = synthetic_mnist(n=1024)
+    kw = dict(worker_optimizer="sgd", learning_rate=0.05, batch_size=16,
+              num_workers=4, communication_window=2, seed=2)
+
+    full = ADAG(_model(), num_epoch=4, **kw)
+    p_full = full.train(ds)
+
+    first = ADAG(_model(), num_epoch=2,
+                 checkpoint_dir=str(tmp_path / "b"), **kw)
+    first.train(ds)
+    assert first.num_updates == 2 * 4 * (1024 // 4 // 32)
+    second = ADAG(_model(), num_epoch=4,
+                  checkpoint_dir=str(tmp_path / "b"), **kw)
+    p_resumed = second.train(ds, resume=True)
+    _params_equal(p_full, p_resumed)
+    # staleness rotation continued from the checkpointed round counter
+    assert second.num_updates == full.num_updates
+
+
+def test_pjit_trainer_resume(tmp_path):
+    ds = synthetic_mnist(n=512)
+    kw = dict(worker_optimizer="momentum", learning_rate=0.05,
+              batch_size=64, num_workers=8, seed=3)
+    full = PjitTrainer(_model(), num_epoch=3, **kw)
+    p_full = full.train(ds)
+
+    PjitTrainer(_model(), num_epoch=1,
+                checkpoint_dir=str(tmp_path / "c"), **kw).train(ds)
+    second = PjitTrainer(_model(), num_epoch=3,
+                         checkpoint_dir=str(tmp_path / "c"), **kw)
+    p_resumed = second.train(ds, resume=True)
+    _params_equal(p_full, p_resumed, rtol=1e-5)
+
+
+def test_host_async_rejects_checkpoint_dir(tmp_path):
+    from distkeras_tpu import DOWNPOUR
+
+    t = DOWNPOUR(_model(), mode="host_async", num_workers=2,
+                 checkpoint_dir=str(tmp_path / "d"))
+    with pytest.raises(ValueError, match="host_async"):
+        t.train(synthetic_mnist(n=256))
