@@ -78,6 +78,10 @@ def gather_rows(src: np.ndarray, idx: np.ndarray,
         # object rows are PyObject pointers — memcpy without incref corrupts
         # the interpreter; those columns stay on the numpy path
         return src[idx]
+    if idx.size and (idx.min() < 0 or idx.max() >= len(src)):
+        raise IndexError(  # match the numpy fallback, don't memcpy OOB
+            f"gather indices out of range [0, {len(src)}): "
+            f"[{idx.min()}, {idx.max()}]")
     src = np.ascontiguousarray(src)
     n = len(idx)
     row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))

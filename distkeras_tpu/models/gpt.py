@@ -87,7 +87,15 @@ class CausalLM(nn.Module):
         pos_table = self.param("pos_embed", nn.initializers.normal(0.02),
                                (self.max_len, self.width))
         if self.attention == "ring":
-            # global positions of this device's block
+            # global positions of this device's block. psum(1) over the mesh
+            # axis is concrete at trace time, so this bound check is static —
+            # without it dynamic_slice would silently CLAMP an out-of-range
+            # offset and reuse another block's position rows.
+            num_blocks = jax.lax.psum(1, self.axis_name)
+            if t * num_blocks > self.max_len:
+                raise ValueError(
+                    f"global sequence {t}*{num_blocks} exceeds max_len "
+                    f"{self.max_len}")
             offset = jax.lax.axis_index(self.axis_name) * t
             pos = jax.lax.dynamic_slice_in_dim(pos_table, offset, t)
         else:

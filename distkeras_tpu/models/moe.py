@@ -1,0 +1,122 @@
+"""Mixture-of-Experts — expert parallelism (GShard/Switch style).
+
+Not in the reference (SURVEY.md §2); completes the parallelism portfolio
+(dp/tp/sp/pp/ep). The classic TPU formulation: top-1 routing with a capacity
+limit, dispatch/combine as one-hot einsums (MXU work, no gather/scatter),
+experts stacked on a leading [E, ...] axis. Under GSPMD, sharding that axis
+over the ``model`` mesh axis turns the dispatch einsums into all-to-alls —
+no hand-written collectives (partition rules in parallel/tensor.py).
+
+Load balancing: the Switch auxiliary loss (fraction-of-tokens x mean-gate
+per expert, scaled by E) is returned via a mutable "losses" collection so
+trainers can fold it into the objective.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.models.transformer import MlpBlock
+
+
+class SwitchMoE(nn.Module):
+    """Top-1 routed MoE over the token dimension of [B, T, W] inputs."""
+
+    num_experts: int
+    mlp_dim: int
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.bfloat16
+    router_noise: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b, t, w = x.shape
+        tokens = b * t
+        e = self.num_experts
+        capacity = max(1, int(self.capacity_factor * tokens / e))
+        xt = x.reshape(tokens, w)
+
+        # router in f32 (softmax over experts must not saturate in bf16)
+        logits = nn.Dense(e, dtype=jnp.float32, name="router")(
+            xt.astype(jnp.float32))
+        if self.router_noise > 0.0 and train:
+            key = self.make_rng("dropout")
+            logits = logits + self.router_noise * jax.random.normal(
+                key, logits.shape)
+        gates = jax.nn.softmax(logits, axis=-1)            # [N, E]
+        expert_idx = jnp.argmax(gates, axis=-1)            # [N]
+        gate = jnp.take_along_axis(gates, expert_idx[:, None], 1)[:, 0]
+
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [N, E]
+        # position of each token within its expert's queue
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0            # [N, E]
+        keep = (pos >= 0) & (pos < capacity)
+        # queue slot of each kept token (non-chosen/overflow entries sum to
+        # 0 — harmless, since dispatch is zeroed by ``onehot * keep`` there)
+        slot = jnp.sum(jnp.where(keep, pos, 0.0), axis=-1).astype(jnp.int32)
+        pos_cap = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)  # [N, C]
+        dispatch = (onehot * keep)[:, :, None] * pos_cap[:, None, :]  # [N,E,C]
+        combine = dispatch * gate[:, None, None]
+
+        # auxiliary load-balance loss (Switch eq. 4)
+        density = jnp.mean(onehot, axis=0)                 # fraction routed
+        density_proxy = jnp.mean(gates, axis=0)            # mean router prob
+        aux = jnp.sum(density * density_proxy) * e
+        self.sow("losses", "moe_aux_loss", aux)
+
+        expert_in = jnp.einsum("nec,nw->ecw", dispatch.astype(self.dtype),
+                               xt.astype(self.dtype))      # [E, C, W]
+        expert_out = nn.vmap(
+            MlpBlock,
+            in_axes=0, out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+        )(self.mlp_dim, 0.0, self.dtype, name="experts")(expert_in)
+        y = jnp.einsum("nec,ecw->nw", combine.astype(self.dtype),
+                       expert_out)                         # [N, W]
+        return y.reshape(b, t, w)
+
+
+class MoEEncoderBlock(nn.Module):
+    """Pre-LN encoder block whose MLP is a SwitchMoE."""
+
+    num_heads: int
+    num_experts: int
+    mlp_dim: int
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        from distkeras_tpu.ops.attention import MultiHeadAttention
+
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(self.dtype)
+        y = MultiHeadAttention(self.num_heads, dtype=self.dtype,
+                               name="attn")(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
+        y = SwitchMoE(self.num_experts, self.mlp_dim, self.capacity_factor,
+                      self.dtype, name="moe")(y, train=train)
+        return x + y
+
+
+# partition rule addition for EP: stack axis of expert params shards over
+# the model axis (see parallel/tensor.DEFAULT_RULES usage)
+EP_RULES = (
+    (r"experts/fc1/kernel$", ("model", None, None)),
+    (r"experts/fc2/kernel$", ("model", None, None)),
+    (r"experts/fc1/bias$", ("model", None)),
+    (r"experts/fc2/bias$", ("model", None)),
+)
+
+
+def ep_partition_rules():
+    """EP rules as PartitionSpecs, prepended to the defaults."""
+    from jax.sharding import PartitionSpec as P
+
+    from distkeras_tpu.parallel import tensor
+
+    converted = tuple((pat, P(*axes)) for pat, axes in EP_RULES)
+    return converted + tuple(tensor.DEFAULT_RULES)

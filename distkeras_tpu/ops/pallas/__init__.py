@@ -1,0 +1,1 @@
+"""Pallas TPU kernels for hot ops the XLA autofuser leaves on the table."""

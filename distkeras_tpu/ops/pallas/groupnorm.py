@@ -1,0 +1,315 @@
+"""Fused GroupNorm — pallas TPU kernel with custom VJP.
+
+Why: profiling the ResNet-50 train step on v5e showed GroupNorm costing
+~17% of step time (bench probe: 31.4% MFU with GN, 39.8% without). XLA runs
+the two-pass mean/var + normalize as separate fusions with extra HBM round
+trips over the big activation tensors. This kernel does ONE read of x per
+pass: group statistics and the normalize run back-to-back in VMEM,
+per-sample blocks on a (batch,) grid.
+
+Trick: group reductions as mask matmuls. A [C, G] one-hot group mask turns
+"sum over channels within each group" into ``x @ mask`` (MXU) — no
+lane-hostile [.., G, C/G] reshapes anywhere; everything stays [rows, C].
+
+Forward:  y = (x - mu_g) * rsqrt(var_g + eps) * gamma_c + beta_c
+Backward: dx = s_c * (dy - mean_g(dy)*m - xhat * mean_g(dy*xhat)*m)
+          with s_c = gamma_c * rsqrt(var_g+eps), group means over n = HW*C/G;
+          dgamma = sum(dy*xhat) over (B, HW);  dbeta = sum(dy).
+
+The public op ``group_norm(x, gamma, beta, groups, eps)`` dispatches to the
+kernel on TPU and to a pure-jnp reference elsewhere (and under
+``interpret=True`` for CPU tests); both share the custom VJP, so numerics
+and gradients agree across backends.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _group_mask(channels: int, groups: int, dtype=jnp.float32):
+    g = np.zeros((channels, groups), np.float32)
+    size = channels // groups
+    for c in range(channels):
+        g[c, c // size] = 1.0
+    return jnp.asarray(g, dtype)
+
+
+# ---------------------------------------------------------------------------
+# reference implementation (CPU path + numerics oracle)
+# ---------------------------------------------------------------------------
+
+def _reference(x, gamma, beta, groups: int, eps: float):
+    b, hw, c = x.shape
+    xf = x.astype(jnp.float32).reshape(b, hw, groups, c // groups)
+    mu = xf.mean(axis=(1, 3), keepdims=True)
+    var = jnp.square(xf - mu).mean(axis=(1, 3), keepdims=True)
+    xhat = ((xf - mu) * jax.lax.rsqrt(var + eps)).reshape(b, hw, c)
+    return (xhat * gamma.astype(jnp.float32) +
+            beta.astype(jnp.float32)).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas kernels
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(x_ref, gamma_ref, beta_ref, mask_ref, y_ref, stat_ref,
+                *, eps: float, inv_n: float):
+    # dtype discipline: block-sized tensors stay in the input dtype (bf16 on
+    # the hot path — an f32 copy of the block is what blows VMEM); all
+    # reductions accumulate in f32 ON THE MXU via ones-vector dots.
+    x = x_ref[0]                                           # [HW, C]
+    mask = mask_ref[:]                                     # [C, G] f32
+    ones = jnp.ones((1, x.shape[0]), x.dtype)
+    s1_c = jnp.dot(ones, x, preferred_element_type=jnp.float32)   # [1, C]
+    s2_c = jnp.dot(ones, x * x, preferred_element_type=jnp.float32)
+    s1 = jnp.dot(s1_c, mask, preferred_element_type=jnp.float32)  # [1, G]
+    s2 = jnp.dot(s2_c, mask, preferred_element_type=jnp.float32)
+    mu = s1 * inv_n
+    var = s2 * inv_n - mu * mu
+    rstd = jax.lax.rsqrt(var + eps)                        # [1, G] f32
+    # per-channel broadcast back: [1, G] @ [G, C] via mask^T
+    mu_c = jnp.dot(mu, mask.T, preferred_element_type=jnp.float32)
+    rstd_c = jnp.dot(rstd, mask.T, preferred_element_type=jnp.float32)
+    gamma = gamma_ref[:].astype(jnp.float32)               # [1, C]
+    beta = beta_ref[:].astype(jnp.float32)
+    scale = rstd_c * gamma                                 # [1, C] f32
+    shift = beta - mu_c * scale
+    y_ref[0] = (x * scale.astype(x.dtype) +
+                shift.astype(x.dtype)).astype(y_ref.dtype)
+    stat_ref[0] = jnp.concatenate([mu, rstd], axis=0)      # [2, G]
+
+
+def _bwd_kernel(x_ref, gamma_ref, stat_ref, dy_ref, mask_ref, dx_ref,
+                dgamma_ref, dbeta_ref, *, eps: float, inv_n: float):
+    x = x_ref[0]                                           # [HW, C] in-dtype
+    dy = dy_ref[0]
+    mask = mask_ref[:]                                     # f32
+    mu = stat_ref[0, 0:1, :]                               # [1, G] f32
+    rstd = stat_ref[0, 1:2, :]                             # [1, G] f32
+    mu_c = jnp.dot(mu, mask.T, preferred_element_type=jnp.float32)
+    rstd_c = jnp.dot(rstd, mask.T, preferred_element_type=jnp.float32)
+    gamma = gamma_ref[:]                                   # [1, C]
+    ones = jnp.ones((1, x.shape[0]), x.dtype)
+
+    xhat = ((x - mu_c.astype(x.dtype)) * rstd_c.astype(x.dtype))
+    dxhat = dy * gamma.astype(dy.dtype)
+    m1 = jnp.dot(jnp.dot(ones, dxhat, preferred_element_type=jnp.float32),
+                 mask, preferred_element_type=jnp.float32) * inv_n  # [1, G]
+    m2 = jnp.dot(jnp.dot(ones, dxhat * xhat,
+                         preferred_element_type=jnp.float32),
+                 mask, preferred_element_type=jnp.float32) * inv_n
+    m1_c = jnp.dot(m1, mask.T, preferred_element_type=jnp.float32)
+    m2_c = jnp.dot(m2, mask.T, preferred_element_type=jnp.float32)
+    dx = rstd_c.astype(x.dtype) * (dxhat - m1_c.astype(x.dtype) -
+                                   xhat * m2_c.astype(x.dtype))
+    dx_ref[0] = dx.astype(dx_ref.dtype)
+    # per-sample partials; summed over the batch grid outside
+    dgamma_ref[0] = jnp.dot(ones, dy * xhat,
+                            preferred_element_type=jnp.float32)
+    dbeta_ref[0] = jnp.dot(ones, dy, preferred_element_type=jnp.float32)
+
+
+def _pallas_fwd(x, gamma, beta, groups: int, eps: float,
+                interpret: bool = False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, hw, c = x.shape
+    mask = _group_mask(c, groups)
+    inv_n = 1.0 / (hw * (c // groups))
+    gamma2 = gamma.reshape(1, c)
+    beta2 = beta.reshape(1, c)
+    kernel = partial(_fwd_kernel, eps=eps, inv_n=inv_n)
+    y, stats = pl.pallas_call(
+        kernel,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, groups), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 2, groups), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hw, c), x.dtype),
+            jax.ShapeDtypeStruct((b, 2, groups), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, gamma2, beta2, mask)
+    return y, stats
+
+
+def _pallas_bwd(x, gamma, stats, dy, groups: int, eps: float,
+                interpret: bool = False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, hw, c = x.shape
+    mask = _group_mask(c, groups)
+    inv_n = 1.0 / (hw * (c // groups))
+    gamma2 = gamma.reshape(1, c)
+    kernel = partial(_bwd_kernel, eps=eps, inv_n=inv_n)
+    dx, dgamma_p, dbeta_p = pl.pallas_call(
+        kernel,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 2, groups), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, groups), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, c), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, c), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hw, c), x.dtype),
+            jax.ShapeDtypeStruct((b, 1, c), jnp.float32),
+            jax.ShapeDtypeStruct((b, 1, c), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, gamma2, stats, dy, mask)
+    return dx, dgamma_p.sum(axis=(0, 1)), dbeta_p.sum(axis=(0, 1))
+
+
+# ---------------------------------------------------------------------------
+# public op with custom VJP (backend dispatch at trace time)
+# ---------------------------------------------------------------------------
+
+#: VMEM budget for one program's working set; leaves headroom under the
+#: 16MB/core scoped-vmem limit. Estimated live blocks: forward ~6x the block
+#: (x + y double-buffered IO, x*x and y temps), backward ~10x (x, dy, dx IO
+#: + xhat/dxhat/product temps).
+_VMEM_BUDGET_BYTES = 14 * 1024 * 1024
+_FWD_BLOCKS = 6
+_BWD_BLOCKS = 10
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _fits(x, factor: int) -> bool:
+    b, hw, c = x.shape
+    c_eff = -(-c // 128) * 128  # lane padding: blocks round up to 128 lanes
+    return factor * hw * c_eff * x.dtype.itemsize <= _VMEM_BUDGET_BYTES
+
+
+def _jnp_bwd_from_stats(x, gamma, stats, dy, groups: int):
+    """XLA backward from saved stats — used when the pallas backward's
+    working set would exceed VMEM (large blocks); same formula."""
+    c = x.shape[-1]
+    mask = _group_mask(c, groups)                    # [C, G]
+    mu_c = (stats[:, 0, :] @ mask.T)[:, None, :]     # [B, 1, C]
+    rstd_c = (stats[:, 1, :] @ mask.T)[:, None, :]
+    xf = x.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    xhat = (xf - mu_c) * rstd_c
+    dxhat = dyf * gamma.astype(jnp.float32)
+    inv_n = 1.0 / (x.shape[1] * (c // groups))
+    m1 = jnp.einsum("bhc,cg->bg", dxhat, mask) * inv_n
+    m2 = jnp.einsum("bhc,cg->bg", dxhat * xhat, mask) * inv_n
+    m1_c = (m1 @ mask.T)[:, None, :]
+    m2_c = (m2 @ mask.T)[:, None, :]
+    dx = (rstd_c * (dxhat - m1_c - xhat * m2_c)).astype(x.dtype)
+    dgamma = jnp.sum(dyf * xhat, axis=(0, 1))
+    dbeta = jnp.sum(dyf, axis=(0, 1))
+    return dx, dgamma, dbeta
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def group_norm(x, gamma, beta, groups: int, eps: float = 1e-6,
+               interpret: bool = False):
+    """Fused GroupNorm over [B, HW, C] (normalize per (sample, group) across
+    HW and the group's channels)."""
+    if interpret or (_on_tpu() and _fits(x, _FWD_BLOCKS)):
+        y, _ = _pallas_fwd(x, gamma, beta, groups, eps, interpret)
+        return y
+    return _reference(x, gamma, beta, groups, eps)
+
+
+def _gn_fwd(x, gamma, beta, groups, eps, interpret):
+    if interpret or (_on_tpu() and _fits(x, _FWD_BLOCKS)):
+        y, stats = _pallas_fwd(x, gamma, beta, groups, eps, interpret)
+        return y, (x, gamma, stats)
+    y = _reference(x, gamma, beta, groups, eps)
+    return y, (x, gamma, None)
+
+
+def _gn_bwd(groups, eps, interpret, res, dy):
+    x, gamma, stats = res
+    if stats is not None:
+        if interpret or _fits(x, _BWD_BLOCKS):
+            dx, dgamma, dbeta = _pallas_bwd(x, gamma, stats, dy, groups,
+                                            eps, interpret)
+        else:  # pallas fwd, XLA bwd from the saved stats (VMEM-bound sizes)
+            dx, dgamma, dbeta = _jnp_bwd_from_stats(x, gamma, stats, dy,
+                                                    groups)
+        return dx, dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype)
+    # reference backward via jax AD on the reference forward
+    _, vjp = jax.vjp(lambda x_, g_, b_: _reference(x_, g_, b_, groups, eps),
+                     x, gamma, beta_like(gamma))
+    dx, dgamma, dbeta = vjp(dy)
+    return dx, dgamma, dbeta
+
+
+def beta_like(gamma):
+    return jnp.zeros_like(gamma)
+
+
+group_norm.defvjp(_gn_fwd, _gn_bwd)
+
+
+# ---------------------------------------------------------------------------
+# flax module (drop-in for nn.GroupNorm: same param names "scale"/"bias")
+# ---------------------------------------------------------------------------
+
+class FusedGroupNorm:
+    """Constructed via __init__ args matching our resnet group_norm helper;
+    implemented as a function-returning factory to avoid a hard flax import
+    at module load."""
+
+    def __new__(cls, num_groups: int, dtype=jnp.bfloat16, name=None,
+                scale_init=None, eps: float = 1e-6):
+        import flax.linen as nn
+
+        class _FusedGroupNorm(nn.Module):
+            num_groups: int
+            dtype: jnp.dtype
+            eps: float
+            scale_init: object
+
+            @nn.compact
+            def __call__(self, x):
+                c = x.shape[-1]
+                init_s = self.scale_init or nn.initializers.ones
+                gamma = self.param("scale", init_s, (c,))
+                beta = self.param("bias", nn.initializers.zeros, (c,))
+                orig = x.shape
+                xr = x.reshape(orig[0], -1, c)
+                y = group_norm(xr, gamma, beta, self.num_groups, self.eps)
+                return y.reshape(orig).astype(self.dtype)
+
+        return _FusedGroupNorm(num_groups=num_groups, dtype=dtype, eps=eps,
+                               scale_init=scale_init, name=name)

@@ -1,0 +1,243 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+Not in the reference (dist-keras has no model parallelism of any kind —
+SURVEY.md §2); built because a complete TPU framework needs all four axes:
+dp (substrate / PjitTrainer), tp (parallel/tensor.py), sp
+(parallel/sequence.py), and pp (this module).
+
+Design — the JAX-native pipeline:
+- The transformer's L decoder blocks are split into P stages of L/P layers;
+  per-stage block params are STACKED with a leading [P, ...] axis and
+  sharded over the ``stages`` mesh axis. Embedding/head params replicate.
+- The forward pass is written as ONE ``lax.scan`` over M + P - 1 ticks
+  inside ``shard_map``: each tick, stage 0 ingests the next microbatch,
+  every stage applies its block stack, the last stage folds loss terms, and
+  activations hop to the next stage via ``ppermute``. A device's idle ticks
+  (pipeline bubble) compute on zeros — the cost model of GPipe.
+- **Backward is free**: ``jax.grad`` differentiates through the scan and the
+  ppermute; AD's transpose of a forward hop is exactly the reverse-schedule
+  hop, and the transpose of replicated params is the cross-stage psum.
+  Nobody hand-writes a 1F1B schedule.
+
+Loss terms are summed with ``psum`` over stages, so the reported loss (and
+therefore the gradients) equal the single-device computation on the same
+global batch — asserted by tests.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.models.gpt import DecoderBlock
+
+STAGE_AXIS = "stages"
+
+
+def make_pp_mesh(num_stages: int, devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if num_stages > len(devices):
+        raise ValueError(f"need {num_stages} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:num_stages]), (STAGE_AXIS,))
+
+
+class PipelinedLM:
+    """Causal LM split into P pipeline stages of L/P decoder blocks each.
+
+    Not a flax module: a factory bundling (a) param init with the stacked
+    stage layout and (b) the shard_map'd train/loss steps. Weights are
+    interchangeable with a single-device model of the same config via the
+    stacked layout (tested).
+    """
+
+    def __init__(self, vocab_size: int, max_len: int, num_layers: int,
+                 num_heads: int, width: int, mlp_dim: int,
+                 num_stages: int, dtype=jnp.float32):
+        if num_layers % num_stages != 0:
+            raise ValueError(f"num_layers {num_layers} must divide evenly "
+                             f"into {num_stages} stages")
+        self.vocab_size = vocab_size
+        self.max_len = max_len
+        self.num_layers = num_layers
+        self.num_stages = num_stages
+        self.layers_per_stage = num_layers // num_stages
+        self.width = width
+        self.dtype = dtype
+        self.block = DecoderBlock(num_heads=num_heads, mlp_dim=mlp_dim,
+                                  dtype=dtype, attention="full")
+
+        class _Embed(nn.Module):
+            vocab: int
+            width: int
+            max_len: int
+            dtype: jnp.dtype
+
+            @nn.compact
+            def __call__(self, ids):
+                x = nn.Embed(self.vocab, self.width, dtype=self.dtype,
+                             name="tok_embed")(ids.astype(jnp.int32))
+                pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                                 (self.max_len, self.width))
+                return x + pos[:ids.shape[-1]].astype(self.dtype)
+
+        class _Head(nn.Module):
+            vocab: int
+            dtype: jnp.dtype
+
+            @nn.compact
+            def __call__(self, x):
+                x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+                return nn.Dense(self.vocab, dtype=jnp.float32,
+                                name="lm_head")(x).astype(jnp.float32)
+
+        self.embed = _Embed(vocab_size, width, max_len, dtype)
+        self.head = _Head(vocab_size, dtype)
+
+    # -- params ------------------------------------------------------------
+    def init(self, rng, sample_ids) -> dict:
+        """{"embed": ..., "blocks": [P, Lp, ...] stacked, "head": ...}"""
+        r_embed, r_block, r_head = jax.random.split(rng, 3)
+        embed = self.embed.init(r_embed, sample_ids)["params"]
+        x = self.embed.apply({"params": embed}, sample_ids)
+
+        def init_layer(key):
+            return self.block.init(key, x)["params"]
+
+        keys = jax.random.split(r_block, self.num_layers)
+        stacked = jax.vmap(init_layer)(keys)  # [L, ...]
+        blocks = jax.tree.map(
+            lambda a: a.reshape((self.num_stages, self.layers_per_stage)
+                                + a.shape[1:]), stacked)
+        head = self.head.init(r_head, x)["params"]
+        return {"embed": embed, "blocks": blocks, "head": head}
+
+    def reference_apply(self, params, ids):
+        """Single-device forward with the SAME stacked weights (oracle)."""
+        x = self.embed.apply({"params": params["embed"]}, ids)
+        flat = jax.tree.map(
+            lambda a: a.reshape((self.num_layers,) + a.shape[2:]),
+            params["blocks"])
+
+        def body(x, layer_params):
+            return self.block.apply({"params": layer_params}, x), None
+
+        x, _ = jax.lax.scan(body, x, flat)
+        return self.head.apply({"params": params["head"]}, x)
+
+    # -- pipelined loss ----------------------------------------------------
+    def _stage_apply(self, block_params, x):
+        def body(x, layer_params):
+            return self.block.apply({"params": layer_params}, x), None
+
+        x, _ = jax.lax.scan(body, x, block_params)
+        return x
+
+    def build_train_step(self, tx: optax.GradientTransformation, mesh: Mesh,
+                         num_microbatches: int):
+        """(step_fn, place_params, place_batch); batch =
+        {"input_ids": [B, T], "labels": [B, T]} with B divisible by
+        num_microbatches; labels < 0 ignored."""
+        M = num_microbatches
+        stages = self.num_stages
+
+        def pp_loss(params, ids_mb, labels_mb):
+            # block params arrive [1, Lp, ...] on each device
+            blocks = jax.tree.map(lambda a: a[0], params["blocks"])
+            stage = jax.lax.axis_index(STAGE_AXIS)
+            mb, t = ids_mb.shape[1], ids_mb.shape[2]
+            zero_act = jnp.zeros((mb, t, self.width), self.dtype)
+
+            def tick(carry, tick_i):
+                buf, nll, hits, cnt = carry
+                in_idx = jnp.clip(tick_i, 0, M - 1)
+                x_in = jax.lax.cond(
+                    stage == 0,
+                    lambda: self.embed.apply(
+                        {"params": params["embed"]},
+                        ids_mb[in_idx]).astype(self.dtype),
+                    lambda: buf)
+                out = self._stage_apply(blocks, x_in)
+
+                out_idx = jnp.clip(tick_i - (stages - 1), 0, M - 1)
+                is_tail = jnp.logical_and(stage == stages - 1,
+                                          tick_i >= stages - 1)
+
+                def tail_loss():
+                    logits = self.head.apply({"params": params["head"]}, out)
+                    labels = labels_mb[out_idx]
+                    valid = labels >= 0
+                    safe = jnp.where(valid, labels, 0).astype(jnp.int32)
+                    logp = jax.nn.log_softmax(logits, axis=-1)
+                    ll = jnp.take_along_axis(logp, safe[..., None],
+                                             axis=-1)[..., 0]
+                    l_nll = -jnp.sum(jnp.where(valid, ll, 0.0))
+                    l_hits = jnp.sum(jnp.where(
+                        valid, jnp.argmax(logits, -1) == safe, False)
+                        .astype(jnp.float32))
+                    l_cnt = jnp.sum(valid.astype(jnp.float32))
+                    return l_nll, l_hits, l_cnt
+
+                l_nll, l_hits, l_cnt = jax.lax.cond(
+                    is_tail, tail_loss,
+                    lambda: (jnp.float32(0), jnp.float32(0), jnp.float32(0)))
+                perm = [(i, i + 1) for i in range(stages - 1)]
+                buf = jax.lax.ppermute(out, STAGE_AXIS, perm)
+                return (buf, nll + l_nll, hits + l_hits, cnt + l_cnt), None
+
+            init = (zero_act, jnp.float32(0), jnp.float32(0), jnp.float32(0))
+            (buf, nll, hits, cnt), _ = jax.lax.scan(
+                tick, init, jnp.arange(M + stages - 1, dtype=jnp.int32))
+            nll = jax.lax.psum(nll, STAGE_AXIS)
+            hits = jax.lax.psum(hits, STAGE_AXIS)
+            cnt = jnp.maximum(jax.lax.psum(cnt, STAGE_AXIS), 1.0)
+            return nll / cnt, (nll, hits, cnt)
+
+        # blocks spec: every leaf sharded on its leading (stage) axis
+        def blocks_spec(blocks):
+            return jax.tree.map(lambda _: P(STAGE_AXIS), blocks)
+
+        def loss_shmapped(params, ids_mb, labels_mb):
+            specs = {"embed": P(), "head": P(),
+                     "blocks": blocks_spec(params["blocks"])}
+            fn = jax.shard_map(
+                pp_loss, mesh=mesh,
+                in_specs=(specs, P(), P()),
+                out_specs=(P(), (P(), P(), P())),
+                check_vma=False)
+            return fn(params, ids_mb, labels_mb)
+
+        def step(params, opt_state, batch):
+            ids, labels = batch["input_ids"], batch["labels"]
+            b = ids.shape[0]
+            ids_mb = ids.reshape(M, b // M, ids.shape[1])
+            labels_mb = labels.reshape(M, b // M, labels.shape[1])
+            (loss, (nll, hits, cnt)), grads = jax.value_and_grad(
+                loss_shmapped, has_aux=True)(params, ids_mb, labels_mb)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {"loss": loss, "accuracy": hits / cnt}
+
+        step_fn = jax.jit(step, donate_argnums=(0, 1))
+
+        def place_params(params):
+            shardings = {
+                "embed": jax.tree.map(
+                    lambda _: NamedSharding(mesh, P()), params["embed"]),
+                "head": jax.tree.map(
+                    lambda _: NamedSharding(mesh, P()), params["head"]),
+                "blocks": jax.tree.map(
+                    lambda _: NamedSharding(mesh, P(STAGE_AXIS)),
+                    params["blocks"]),
+            }
+            return jax.device_put(params, shardings)
+
+        def place_batch(batch):
+            return jax.device_put(batch, NamedSharding(mesh, P()))
+
+        return step_fn, place_params, place_batch

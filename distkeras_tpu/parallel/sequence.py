@@ -35,7 +35,7 @@ def make_sp_mesh(num_workers: int = 1, seq_parallelism: int = 1,
     return Mesh(grid, (mesh_lib.WORKER_AXIS, SEQ_AXIS))
 
 
-def shift_labels(input_ids: np.ndarray, pad_to_ignore: bool = True) -> np.ndarray:
+def shift_labels(input_ids: np.ndarray) -> np.ndarray:
     """Host-side next-token labels: labels[t] = ids[t+1]; final position
     ignored (-1). Done globally BEFORE sequence sharding so block boundaries
     need no device-to-device shift."""

@@ -137,13 +137,26 @@ def build_pjit_epoch_fn(model, loss, tx: optax.GradientTransformation,
     data_sharding = NamedSharding(mesh, P(None, mesh_lib.WORKER_AXIS))
 
     def place_state(state):
+        # optimizer-state leaves that mirror a param shape (adam's mu/nu,
+        # momentum buffers) take that param's sharding — otherwise TP's
+        # memory savings are lost to replicated 2x-param optimizer state
+        specs = partition_specs(state.params, rules, mesh)
+        shape_to_spec = {}
+        for spec, leaf in zip(jax.tree.leaves(
+                specs, is_leaf=lambda x: isinstance(x, P)),
+                jax.tree.leaves(state.params)):
+            shape_to_spec.setdefault(np.shape(leaf), spec)
+
+        def opt_sharding(leaf):
+            spec = shape_to_spec.get(np.shape(leaf), P())
+            return NamedSharding(mesh, spec)
+
         return engine.TrainState(
             step=jax.device_put(state.step, NamedSharding(mesh, P())),
             params=shard_params(state.params, mesh, rules),
             opt_state=jax.device_put(
                 state.opt_state,
-                jax.tree.map(lambda _: NamedSharding(mesh, P()),
-                             state.opt_state)))
+                jax.tree.map(opt_sharding, state.opt_state)))
 
     def place_data(data):
         return jax.device_put(data, data_sharding)

@@ -1,0 +1,54 @@
+"""Fault tolerance: restart-from-checkpoint retry loop.
+
+Reference parity (SURVEY.md §5): dist-keras had NO failure handling of its
+own — Spark retried failed tasks and the parameter server was an unpersisted
+single point of failure. The TPU-native story makes the checkpoint the
+recovery primitive: the trainer snapshots per epoch (``checkpoint_dir=``),
+and this runner resumes it across crashes — the moral equivalent of
+"Spark-grade retry".
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+logger = logging.getLogger("distkeras_tpu.fault")
+
+
+def run_with_retries(trainer, dataset, shuffle: bool = False,
+                     max_restarts: int = 3,
+                     backoff_s: float = 1.0,
+                     retry_on: tuple = (Exception,),
+                     no_retry_on: tuple = (ValueError, TypeError)):
+    """``trainer.train`` with automatic resume-from-checkpoint on failure.
+
+    The trainer must have been constructed with ``checkpoint_dir`` (otherwise
+    a retry restarts from scratch, which is still a retry — a warning is
+    logged). Returns the trained params; re-raises after ``max_restarts``
+    failed attempts. Deterministic configuration errors (``no_retry_on``,
+    default ValueError/TypeError) surface immediately — retrying them with
+    backoff would only mask the bug.
+    """
+    if getattr(trainer, "checkpoint_dir", None) is None:
+        logger.warning(
+            "run_with_retries: trainer has no checkpoint_dir; retries will "
+            "restart training from scratch")
+    attempt = 0
+    while True:
+        try:
+            return trainer.train(dataset, shuffle=shuffle,
+                                 resume=attempt > 0)
+        except no_retry_on:
+            raise
+        except retry_on as e:  # noqa: PERF203
+            attempt += 1
+            if attempt > max_restarts:
+                logger.error("run_with_retries: giving up after %d restarts",
+                             max_restarts)
+                raise
+            logger.warning("run_with_retries: attempt %d failed (%s: %s); "
+                           "resuming from checkpoint", attempt,
+                           type(e).__name__, e)
+            time.sleep(backoff_s * attempt)

@@ -1,0 +1,70 @@
+"""Fault-tolerance runner: crash mid-training, resume, identical result."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import SingleTrainer, synthetic_mnist
+from distkeras_tpu.models.mlp import MLP
+from distkeras_tpu.utils.fault import run_with_retries
+
+
+class _CrashingTrainer(SingleTrainer):
+    """Crashes once after the first epoch's checkpoint has been written."""
+
+    crashes_left = 1
+
+    def train(self, dataset, shuffle=False, resume=False):
+        if type(self).crashes_left > 0 and not resume:
+            # run one epoch (writes checkpoint 0) then die
+            real_epochs = self.num_epoch
+            self.num_epoch = 1
+            super().train(dataset, shuffle=shuffle, resume=resume)
+            self.num_epoch = real_epochs
+            type(self).crashes_left -= 1
+            raise RuntimeError("injected failure after epoch 0")
+        return super().train(dataset, shuffle=shuffle, resume=resume)
+
+
+def test_run_with_retries_resumes_and_matches(tmp_path):
+    import jax
+
+    ds = synthetic_mnist(n=512)
+    kw = dict(worker_optimizer="sgd", learning_rate=0.05, batch_size=64,
+              num_epoch=3, seed=5)
+
+    clean = SingleTrainer(MLP(features=(16,)), **kw)
+    p_clean = clean.train(ds)
+
+    _CrashingTrainer.crashes_left = 1
+    crashy = _CrashingTrainer(MLP(features=(16,)),
+                              checkpoint_dir=str(tmp_path / "ck"), **kw)
+    p_retried = run_with_retries(crashy, ds, max_restarts=2, backoff_s=0.0)
+    for a, b in zip(jax.tree.leaves(p_clean), jax.tree.leaves(p_retried)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6,
+                                   atol=1e-7)
+
+
+def test_config_errors_not_retried():
+    calls = []
+
+    class BadConfig(SingleTrainer):
+        def train(self, dataset, shuffle=False, resume=False):
+            calls.append(1)
+            raise ValueError("bad config")
+
+    t = BadConfig(MLP(features=(16,)), batch_size=64)
+    with pytest.raises(ValueError, match="bad config"):
+        run_with_retries(t, synthetic_mnist(n=128), max_restarts=3,
+                         backoff_s=0.0)
+    assert len(calls) == 1  # surfaced immediately, no retries
+
+
+def test_run_with_retries_gives_up():
+    class AlwaysCrash(SingleTrainer):
+        def train(self, dataset, shuffle=False, resume=False):
+            raise RuntimeError("boom")
+
+    t = AlwaysCrash(MLP(features=(16,)), batch_size=64)
+    with pytest.raises(RuntimeError, match="boom"):
+        run_with_retries(t, synthetic_mnist(n=128), max_restarts=2,
+                         backoff_s=0.0)

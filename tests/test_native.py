@@ -22,6 +22,16 @@ def test_gather_rows_matches_numpy():
         assert out.dtype == src.dtype
 
 
+def test_gather_rows_bounds_checked():
+    import pytest
+
+    src = np.zeros((10, 4), np.float32)
+    with pytest.raises(IndexError):
+        native.gather_rows(src, np.array([0, 10], np.int64))
+    with pytest.raises(IndexError):
+        native.gather_rows(src, np.array([-1], np.int64))
+
+
 def test_native_permutation_valid_and_deterministic():
     p1 = native.permutation(10_001, seed=42)
     p2 = native.permutation(10_001, seed=42)

@@ -1,0 +1,84 @@
+"""Pipeline parallelism: stage schedule vs single-device oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distkeras_tpu.parallel import pipeline as pp
+from distkeras_tpu.parallel import sequence as seq_lib
+
+
+def _model(stages=4, layers=4):
+    return pp.PipelinedLM(vocab_size=64, max_len=32, num_layers=layers,
+                          num_heads=2, width=32, mlp_dim=64,
+                          num_stages=stages)
+
+
+def _batch(b=8, t=16, vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, (b, t)).astype(np.int32)
+    return {"input_ids": ids, "labels": seq_lib.shift_labels(ids)}
+
+
+def _ref_loss_and_grads(model, params, batch):
+    def loss_fn(p):
+        logits = model.reference_apply(p, jnp.asarray(batch["input_ids"]))
+        labels = jnp.asarray(batch["labels"])
+        valid = labels >= 0
+        safe = jnp.where(valid, labels, 0).astype(jnp.int32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        return -jnp.sum(jnp.where(valid, ll, 0.0)) / jnp.sum(valid)
+
+    return jax.value_and_grad(loss_fn)(params)
+
+
+def test_pp_step_matches_single_device():
+    model = _model(stages=4, layers=4)
+    mesh = pp.make_pp_mesh(4)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    params = model.init(jax.random.key(0), ids)
+    batch = _batch()
+    tx = optax.sgd(0.1)
+
+    step_fn, place_params, place_batch = model.build_train_step(
+        tx, mesh, num_microbatches=4)
+    ref_loss, ref_grads = _ref_loss_and_grads(model, params, batch)
+    # params after one SGD step == reference params - lr * grads; computed on
+    # host BEFORE the donating step_fn can recycle any aliased buffers
+    expected = jax.tree.map(
+        lambda p, g: np.asarray(p) - 0.1 * np.asarray(g), params, ref_grads)
+
+    p_dev = place_params(params)
+    opt_state = tx.init(p_dev)
+    new_params, _, ms = step_fn(p_dev, opt_state, place_batch(batch))
+    np.testing.assert_allclose(float(ms["loss"]), float(ref_loss), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(jax.device_get(new_params)),
+                    jax.tree.leaves(jax.device_get(expected))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-6)
+
+
+def test_pp_eight_stages_trains():
+    model = _model(stages=8, layers=8)
+    mesh = pp.make_pp_mesh(8)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    params = model.init(jax.random.key(1), ids)
+    tx = optax.adam(3e-3)
+    step_fn, place_params, place_batch = model.build_train_step(
+        tx, mesh, num_microbatches=2)
+    p = place_params(params)
+    opt = tx.init(p)
+    batch = place_batch(_batch(seed=1))
+    losses = []
+    for _ in range(15):
+        p, opt, ms = step_fn(p, opt, batch)
+        losses.append(float(ms["loss"]))
+    assert losses[-1] < losses[0] * 0.9, (losses[0], losses[-1])
+
+
+def test_pp_layer_count_validation():
+    with pytest.raises(ValueError, match="divide"):
+        _model(stages=4, layers=6)

@@ -70,6 +70,21 @@ def test_sp_training_reduces_loss():
     assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
 
 
+def test_sp_rejects_sequence_beyond_max_len():
+    """The ring path must fail loudly (not silently clamp positions) when
+    the global sequence exceeds max_len."""
+    import pytest
+
+    mesh = seq_lib.make_sp_mesh(num_workers=1, seq_parallelism=8)
+    model = gpt_tiny(attention="ring", max_len=64)
+    tx = optax.sgd(0.01)
+    state = seq_lib.init_sp_state(model, tx, mesh, (2, 128 // 8))
+    step_fn, _, place_batch = seq_lib.build_sp_train_step(model, tx, mesh)
+    batch = place_batch(_batch(b=2, t=128, seed=3))  # 128 > max_len 64
+    with pytest.raises(ValueError, match="max_len"):
+        step_fn(state, batch)
+
+
 def test_sp_long_sequence_runs():
     """Sequence longer than any single device would want: 8 blocks x 128."""
     mesh = seq_lib.make_sp_mesh(num_workers=1, seq_parallelism=8)
