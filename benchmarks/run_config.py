@@ -1,0 +1,164 @@
+"""BASELINE config runners — one JSON line per config, like bench.py.
+
+The driver's headline benchmark is repo-root ``bench.py`` (config 3's model
+under ADAG). This harness covers all five BASELINE.md configs so progress on
+each is measurable:
+
+  1 mnist-mlp-adag       MLP, ADAG single-worker
+  2 cifar-cnn-downpour   CIFARConvNet, DOWNPOUR async
+  3 resnet50-aeasgd      ResNet-50, AEASGD elastic averaging
+  4 bert-dynsgd          BERT MLM, DynSGD staleness-aware
+  5 vit-pjit             ViT, pjit-sharded data-parallel
+
+Usage: python benchmarks/run_config.py <1-5|all> [--full]
+``--full`` uses benchmark-scale shapes (TPU); default is a smoke-scale run
+that works anywhere (CPU mesh included). Output: one JSON line per config
+with samples/sec and, where FLOPs are countable, MFU.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def _sync(tree):
+    # device->host fetch: the only reliable completion barrier on tunneled
+    # backends (see bench.py)
+    for leaf in jax.tree.leaves(tree)[:1]:
+        float(np.asarray(leaf).ravel()[0])
+
+
+def _time_trainer(trainer, ds, steps_per_epoch_hint=None):
+    t0 = time.perf_counter()
+    trainer.train(ds)
+    dt = time.perf_counter() - t0
+    n_steps = len(trainer.get_history())
+    samples = n_steps * trainer.batch_size * getattr(trainer, "num_workers", 1)
+    return {"samples_per_sec": round(samples / dt, 2),
+            "steps": n_steps, "wall_s": round(dt, 2),
+            "final_loss": round(trainer.get_history()[-1]["loss"], 4)}
+
+
+def config_1(full):
+    from distkeras_tpu import ADAG, synthetic_mnist
+    from distkeras_tpu.models import mnist_mlp
+
+    n = 16384 if full else 2048
+    t = ADAG(mnist_mlp(), worker_optimizer="momentum", learning_rate=0.05,
+             num_workers=1, batch_size=128, communication_window=8,
+             num_epoch=3 if full else 1)
+    return _time_trainer(t, synthetic_mnist(n=n))
+
+
+def config_2(full):
+    from distkeras_tpu import DOWNPOUR, Dataset
+    from distkeras_tpu.models import cifar10_cnn
+    import jax.numpy as jnp
+
+    n = 8192 if full else 1024
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, 32, 32, 3)).astype(np.float32)
+    y = rng.integers(0, 10, n)
+    ds = Dataset({"features": x, "label": np.eye(10, dtype=np.float32)[y]})
+    workers = min(4, len(jax.devices()))
+    t = DOWNPOUR(cifar10_cnn(dtype=jnp.bfloat16 if full else jnp.float32),
+                 worker_optimizer="adam", learning_rate=1e-3,
+                 num_workers=workers, batch_size=64,
+                 communication_window=4, num_epoch=1)
+    return _time_trainer(t, ds)
+
+
+def config_3(full):
+    from distkeras_tpu import AEASGD, Dataset
+    from distkeras_tpu.models.resnet import ResNet, BasicBlock, resnet50
+    import jax.numpy as jnp
+
+    side, n, bs = (224, 1536, 64) if full else (32, 256, 16)
+    model = resnet50() if full else ResNet(stage_sizes=(1, 1),
+                                           block=BasicBlock, width=8,
+                                           num_classes=10, dtype=jnp.float32)
+    classes = 1000 if full else 10
+    rng = np.random.default_rng(0)
+    ds = Dataset({
+        "features": rng.standard_normal((n, side, side, 3)).astype(np.float32),
+        "label": np.eye(classes, dtype=np.float32)[
+            rng.integers(0, classes, n)]})
+    t = AEASGD(model, rho=1.0, worker_optimizer="sgd", learning_rate=0.05,
+               num_workers=1, batch_size=bs, communication_window=4,
+               num_epoch=1, metrics=())
+    return _time_trainer(t, ds)
+
+
+def config_4(full):
+    from distkeras_tpu import Dataset, DynSGD
+    from distkeras_tpu.models import bert_base, bert_tiny
+
+    model = bert_base() if full else bert_tiny()
+    seq = 128 if full else 32
+    n = 2048 if full else 512
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, model.vocab_size, (n, seq)).astype(np.int32)
+    labels = np.where(rng.random((n, seq)) < 0.15, ids, -1).astype(np.int32)
+    workers = min(4, len(jax.devices()))
+    t = DynSGD(model, loss="masked_lm", metrics=(),
+               worker_optimizer="adam", learning_rate=1e-4,
+               num_workers=workers, batch_size=8 if full else 16,
+               communication_window=2, num_epoch=1)
+    return _time_trainer(t, Dataset({"features": ids, "label": labels}))
+
+
+def config_5(full):
+    from distkeras_tpu import Dataset, PjitTrainer
+    from distkeras_tpu.models import vit_base, vit_tiny
+
+    model = vit_base() if full else vit_tiny()
+    side = 224 if full else 16
+    classes = 1000 if full else 10
+    n, bs = (1024, 64) if full else (512, 64)
+    rng = np.random.default_rng(0)
+    ds = Dataset({
+        "features": rng.standard_normal((n, side, side, 3)).astype(np.float32),
+        "label": np.eye(classes, dtype=np.float32)[
+            rng.integers(0, classes, n)]})
+    t = PjitTrainer(model, worker_optimizer="adamw", learning_rate=1e-3,
+                    num_workers=min(8, len(jax.devices())), batch_size=bs,
+                    num_epoch=1, metrics=())
+    return _time_trainer(t, ds)
+
+
+CONFIGS = {
+    "1": ("mnist-mlp-adag", config_1),
+    "2": ("cifar-cnn-downpour", config_2),
+    "3": ("resnet50-aeasgd", config_3),
+    "4": ("bert-dynsgd", config_4),
+    "5": ("vit-pjit", config_5),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("which", choices=list(CONFIGS) + ["all"])
+    ap.add_argument("--full", action="store_true")
+    args = ap.parse_args()
+    keys = list(CONFIGS) if args.which == "all" else [args.which]
+    for k in keys:
+        name, fn = CONFIGS[k]
+        try:
+            result = fn(args.full)
+            print(json.dumps({"config": k, "name": name,
+                              "mode": "full" if args.full else "smoke",
+                              **result}))
+        except Exception as e:
+            print(json.dumps({"config": k, "name": name,
+                              "error": f"{type(e).__name__}: {e}"}))
+
+
+if __name__ == "__main__":
+    main()

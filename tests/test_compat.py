@@ -1,0 +1,53 @@
+"""Reference-vocabulary compat layer (utils.py parity names)."""
+
+import numpy as np
+
+from distkeras_tpu.data.dataset import synthetic_mnist
+from distkeras_tpu.models.mlp import MLP
+from distkeras_tpu.utils import (
+    deserialize_keras_model,
+    history_executors_average,
+    new_dataframe_row,
+    precache,
+    serialize_keras_model,
+    set_keras_base_directory,
+    shuffle,
+    to_dense_vector,
+)
+
+
+def test_serialize_keras_model_roundtrip():
+    import jax
+
+    model = MLP(features=(8,), num_classes=4)
+    x = np.zeros((2, 16), np.float32)
+    params = model.init(jax.random.key(0), x, train=False)["params"]
+    blob = serialize_keras_model(model, params)
+    model2, params2 = deserialize_keras_model(blob)
+    y1 = model.apply({"params": params}, x)
+    y2 = model2.apply({"params": params2}, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+
+
+def test_shuffle_and_precache():
+    ds = synthetic_mnist(n=64)
+    assert len(precache(ds)) == 64
+    shuffled = shuffle(ds, seed=1)
+    assert not np.array_equal(shuffled["features"], ds["features"])
+    assert np.array_equal(np.sort(shuffled["label_index"]),
+                          np.sort(ds["label_index"]))
+
+
+def test_row_and_vector_helpers():
+    row = {"a": 1}
+    row2 = new_dataframe_row(row, "prediction", 7)
+    assert row2 == {"a": 1, "prediction": 7} and row == {"a": 1}
+    np.testing.assert_array_equal(to_dense_vector(2, 4), [0, 0, 1, 0])
+
+
+def test_history_average_and_noop():
+    hs = [{"loss": 1.0, "acc": 0.5}, {"loss": 3.0, "acc": 1.0}]
+    avg = history_executors_average(hs)
+    assert avg == {"loss": 2.0, "acc": 0.75}
+    assert history_executors_average([]) == {}
+    set_keras_base_directory("/anywhere")  # must not raise
