@@ -12,13 +12,21 @@ env var alone is NOT enough — jax.config.update after import is what sticks
 """
 
 import os
+import re
 
+# Keep in sync with __graft_entry__.dryrun_multichip: upgrade (never keep) a
+# pre-set smaller host device count, so a stale XLA_FLAGS can't starve the
+# 8-device mesh. Stdlib-only: must run before the first `import jax`, and the
+# package itself imports jax, so this can't live in distkeras_tpu.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+_pat = r"--xla_force_host_platform_device_count=(\d+)"
+_m = re.search(_pat, _flags)
+if _m is None:
+    _flags += " --xla_force_host_platform_device_count=8"
+elif int(_m.group(1)) < 8:
+    _flags = re.sub(_pat, "--xla_force_host_platform_device_count=8", _flags)
+os.environ["XLA_FLAGS"] = _flags.strip()
 
 import jax  # noqa: E402
 
