@@ -1,0 +1,9 @@
+"""Benchmark harness for the five BASELINE.md configs.
+
+Run with ``python -m distkeras_tpu.benchmarks <1-5|all> [--full]`` or the
+``distkeras-tpu-bench`` console script.
+"""
+
+from distkeras_tpu.benchmarks.run_config import CONFIGS, main
+
+__all__ = ["CONFIGS", "main"]

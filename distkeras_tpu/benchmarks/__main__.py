@@ -1,0 +1,4 @@
+from distkeras_tpu.benchmarks.run_config import main
+
+if __name__ == "__main__":
+    main()

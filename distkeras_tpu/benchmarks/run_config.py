@@ -10,7 +10,8 @@ each is measurable:
   4 bert-dynsgd          BERT MLM, DynSGD staleness-aware
   5 vit-pjit             ViT, pjit-sharded data-parallel
 
-Usage: python benchmarks/run_config.py <1-5|all> [--full]
+Usage: python -m distkeras_tpu.benchmarks <1-5|all> [--full]
+       (or the ``distkeras-tpu-bench`` console script)
 ``--full`` uses benchmark-scale shapes (TPU); default is a smoke-scale run
 that works anywhere (CPU mesh included). Output: one JSON line per config
 with samples/sec and, where FLOPs are countable, MFU.
@@ -18,11 +19,7 @@ with samples/sec and, where FLOPs are countable, MFU.
 
 import argparse
 import json
-import os
-import sys
 import time
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import numpy as np

@@ -58,6 +58,15 @@ class Checkpointer:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def clear(self) -> None:
+        """Delete every saved step. Orbax's CheckpointManager silently SKIPS
+        ``save(step)`` when that step already exists, so a fresh run pointed
+        at a previous run's directory must clear it or its saves are no-ops
+        and a later resume would restore the stale run's state."""
+        self._mgr.wait_until_finished()
+        for step in self.all_steps():
+            self._mgr.delete(int(step))
+
     def all_steps(self):
         return sorted(self._mgr.all_steps())
 

@@ -1,9 +1,11 @@
-"""ctypes binding for the native batch assembler (native/batcher.cc).
+"""ctypes binding for the native batch assembler (native_src/batcher.cc).
 
-Compiled on first use with g++ (cached under native/); every entry point
-falls back to NumPy when the toolchain or the .so is unavailable, so the
-framework never hard-depends on the native path — it is a throughput
-optimization for the host side of the input pipeline.
+Compiled on first use with g++, cached next to the source (or under
+``~/.cache/distkeras_tpu`` when the install dir is read-only, e.g. a system
+site-packages); every entry point falls back to NumPy when the toolchain or
+the .so is unavailable, so the framework never hard-depends on the native
+path — it is a throughput optimization for the host side of the input
+pipeline.
 """
 
 from __future__ import annotations
@@ -20,23 +22,34 @@ _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
-_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__)))), "native", "batcher.cc")
-_SO = os.path.join(os.path.dirname(_SRC), "libdkbatch.so")
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native_src", "batcher.cc")
+_CACHE_SO = os.path.join(
+    os.environ.get("XDG_CACHE_HOME",
+                   os.path.join(os.path.expanduser("~"), ".cache")),
+    "distkeras_tpu", "libdkbatch.so")
 
 
 def _build() -> Optional[str]:
-    try:
-        if os.path.exists(_SO) and (not os.path.exists(_SRC) or
-                                    os.path.getmtime(_SO) >=
-                                    os.path.getmtime(_SRC)):
-            return _SO
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC, "-lpthread"],
-            check=True, capture_output=True, timeout=120)
-        return _SO
-    except Exception:
+    if not os.path.exists(_SRC):
         return None
+    # Prefer caching next to the source (source checkouts); fall back to the
+    # user cache dir when the install location is read-only (system installs).
+    for so in (os.path.join(os.path.dirname(_SRC), "libdkbatch.so"),
+               _CACHE_SO):
+        try:
+            if os.path.exists(so) and (os.path.getmtime(so) >=
+                                       os.path.getmtime(_SRC)):
+                return so
+            os.makedirs(os.path.dirname(so), exist_ok=True)
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", so, _SRC,
+                 "-lpthread"],
+                check=True, capture_output=True, timeout=120)
+            return so
+        except Exception:
+            continue
+    return None
 
 
 def _lib() -> Optional[ctypes.CDLL]:

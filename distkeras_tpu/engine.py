@@ -55,14 +55,23 @@ def create_train_state(model, rng, sample_batch: Batch,
 
 def make_loss_fn(model, loss) -> Callable:
     """(params, batch, rngs) -> (scalar loss, logits). Resolves Keras-style
-    loss names. Logits ride along as aux so metrics reuse the forward pass."""
+    loss names. Logits ride along as aux so metrics reuse the forward pass.
+
+    The forward pass runs with ``mutable=["losses"]`` so auxiliary losses
+    sown by modules (e.g. the Switch-MoE load-balance term, already scaled
+    by the module's own weight) are folded into the objective — every
+    trainer gets them for free."""
     loss_fn = losses_lib.get(loss)
 
     def compute(params, batch: Batch, rngs: Optional[dict] = None):
         kwargs = {"rngs": rngs} if rngs else {}
-        logits = model.apply({"params": params}, batch["features"], train=True,
-                             **kwargs)
-        return loss_fn(logits, batch["labels"]), logits
+        logits, mutated = model.apply(
+            {"params": params}, batch["features"], train=True,
+            mutable=["losses"], **kwargs)
+        total = loss_fn(logits, batch["labels"])
+        for aux in jax.tree.leaves(mutated.get("losses", {})):
+            total = total + jnp.sum(aux)
+        return total, logits
 
     return compute
 

@@ -29,6 +29,7 @@ class SwitchMoE(nn.Module):
     capacity_factor: float = 1.25
     dtype: jnp.dtype = jnp.bfloat16
     router_noise: float = 0.0
+    aux_loss_weight: float = 0.01  # Switch paper's alpha
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -60,11 +61,12 @@ class SwitchMoE(nn.Module):
         dispatch = (onehot * keep)[:, :, None] * pos_cap[:, None, :]  # [N,E,C]
         combine = dispatch * gate[:, None, None]
 
-        # auxiliary load-balance loss (Switch eq. 4)
+        # auxiliary load-balance loss (Switch eq. 4), sown pre-scaled so
+        # engine.make_loss_fn can fold the collection in by plain summation
         density = jnp.mean(onehot, axis=0)                 # fraction routed
         density_proxy = jnp.mean(gates, axis=0)            # mean router prob
         aux = jnp.sum(density * density_proxy) * e
-        self.sow("losses", "moe_aux_loss", aux)
+        self.sow("losses", "moe_aux_loss", self.aux_loss_weight * aux)
 
         expert_in = jnp.einsum("nec,nw->ecw", dispatch.astype(self.dtype),
                                xt.astype(self.dtype))      # [E, C, W]
@@ -87,6 +89,7 @@ class MoEEncoderBlock(nn.Module):
     mlp_dim: int
     capacity_factor: float = 1.25
     dtype: jnp.dtype = jnp.bfloat16
+    aux_loss_weight: float = 0.01
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -98,7 +101,8 @@ class MoEEncoderBlock(nn.Module):
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
         y = SwitchMoE(self.num_experts, self.mlp_dim, self.capacity_factor,
-                      self.dtype, name="moe")(y, train=train)
+                      self.dtype, aux_loss_weight=self.aux_loss_weight,
+                      name="moe")(y, train=train)
         return x + y
 
 

@@ -136,6 +136,29 @@ def stage_epoch_data(shards, features_col: str, label_col: str,
     Every worker gets the same round count (static shapes — XLA's contract);
     the common count is the smallest shard's, surplus rows are dropped (the
     reference's analogue: Spark partitions simply finish at different times).
+
+    This is the whole-epoch-resident path (fine for benchmark-sized data);
+    for datasets that don't fit as one device buffer use
+    :func:`stage_epoch_chunks`.
+    """
+    return next(stage_epoch_chunks(shards, features_col, label_col,
+                                   batch_size, window, mesh,
+                                   max_rounds=max_rounds))
+
+
+def stage_epoch_chunks(shards, features_col: str, label_col: str,
+                       batch_size: int, window: int, mesh: Mesh,
+                       chunk_rounds: Optional[int] = None,
+                       max_rounds: Optional[int] = None):
+    """Yield ``(device_data, rounds)`` chunks of at most ``chunk_rounds``
+    rounds each, keeping staging memory O(chunk) instead of O(epoch).
+
+    ``jax.device_put`` is asynchronous, so a caller that dispatches the
+    (also asynchronous) epoch computation on chunk *i* and only then pulls
+    chunk *i+1* from this generator gets host slicing + host->device
+    transfer overlapped with device compute — double buffering without any
+    explicit machinery. The final chunk may be ragged (one extra XLA
+    compilation, amortized across epochs).
     """
     per_round = batch_size * window
     rounds = min(len(s) // per_round for s in shards)
@@ -145,13 +168,21 @@ def stage_epoch_data(shards, features_col: str, label_col: str,
         raise ValueError(
             f"Shards of sizes {[len(s) for s in shards]} cannot form a "
             f"single round of window={window} x batch={batch_size}")
-    n = rounds * per_round
+    if chunk_rounds is None:
+        chunk_rounds = rounds
+    cols = {"features": features_col, "labels": label_col}
+    arrs = {key: [np.asarray(s[col]) for s in shards]
+            for key, col in cols.items()}
+    sharding = mesh_lib.worker_sharded(mesh)
+    for start in range(0, rounds, chunk_rounds):
+        cnt = min(chunk_rounds, rounds - start)
+        lo = start * per_round
+        hi = lo + cnt * per_round
 
-    def stack(col):
-        arrs = [np.asarray(s[col][:n]).reshape(
-            (rounds, window, batch_size) + np.asarray(s[col]).shape[1:])
-            for s in shards]
-        return np.stack(arrs)
+        def stack(key):
+            return np.stack([
+                a[lo:hi].reshape((cnt, window, batch_size) + a.shape[1:])
+                for a in arrs[key]])
 
-    data = {"features": stack(features_col), "labels": stack(label_col)}
-    return jax.device_put(data, mesh_lib.worker_sharded(mesh)), rounds
+        data = {key: stack(key) for key in cols}
+        yield jax.device_put(data, sharding), cnt

@@ -72,18 +72,23 @@ def partition_specs(params: Any, rules: Optional[Rules] = None,
         name = path_str(path)
         for pat, spec in compiled:
             if pat.search(name):
-                if len(spec) > np.ndim(leaf):
-                    return P()
-                for dim, axis in enumerate(spec):
-                    if axis is None:
-                        continue
-                    size = axis_sizes.get(axis)
-                    if size and np.shape(leaf)[dim] % size != 0:
-                        return P()  # indivisible: replicate instead
-                return spec
+                return spec if _spec_fits(spec, leaf, axis_sizes) else P()
         return P()
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def _spec_fits(spec: P, leaf, axis_sizes: dict) -> bool:
+    """True when every named axis of ``spec`` divides the matching dim."""
+    if len(spec) > np.ndim(leaf):
+        return False
+    for dim, axis in enumerate(spec):
+        if axis is None:
+            continue
+        size = axis_sizes.get(axis)
+        if size and np.shape(leaf)[dim] % size != 0:
+            return False
+    return True
 
 
 def shard_params(params: Any, mesh: Mesh,
@@ -137,26 +142,39 @@ def build_pjit_epoch_fn(model, loss, tx: optax.GradientTransformation,
     data_sharding = NamedSharding(mesh, P(None, mesh_lib.WORKER_AXIS))
 
     def place_state(state):
-        # optimizer-state leaves that mirror a param shape (adam's mu/nu,
-        # momentum buffers) take that param's sharding — otherwise TP's
-        # memory savings are lost to replicated 2x-param optimizer state
+        # Optimizer-state subtrees that mirror the param tree (adam's mu/nu,
+        # momentum buffers — optax states are params-shaped pytrees) take the
+        # params' shardings STRUCTURALLY, leaf for leaf — otherwise TP's
+        # memory savings are lost to replicated 2x-param optimizer state.
+        # Matching by tree structure (not leaf shape) keeps two same-shaped,
+        # differently-sharded params from colliding onto one spec.
         specs = partition_specs(state.params, rules, mesh)
-        shape_to_spec = {}
-        for spec, leaf in zip(jax.tree.leaves(
-                specs, is_leaf=lambda x: isinstance(x, P)),
-                jax.tree.leaves(state.params)):
-            shape_to_spec.setdefault(np.shape(leaf), spec)
+        param_treedef = jax.tree.structure(state.params)
+        axis_sizes = dict(mesh.shape)
+        is_spec = lambda x: isinstance(x, P)
 
-        def opt_sharding(leaf):
-            spec = shape_to_spec.get(np.shape(leaf), P())
-            return NamedSharding(mesh, spec)
+        def params_like(sub):
+            try:
+                return jax.tree.structure(sub) == param_treedef
+            except Exception:
+                return False
+
+        def opt_subtree_shardings(sub):
+            if params_like(sub):
+                return jax.tree.map(
+                    lambda spec, leaf: NamedSharding(
+                        mesh,
+                        spec if _spec_fits(spec, leaf, axis_sizes) else P()),
+                    specs, sub, is_leaf=is_spec)
+            return jax.tree.map(lambda _: NamedSharding(mesh, P()), sub)
 
         return engine.TrainState(
             step=jax.device_put(state.step, NamedSharding(mesh, P())),
             params=shard_params(state.params, mesh, rules),
             opt_state=jax.device_put(
                 state.opt_state,
-                jax.tree.map(opt_sharding, state.opt_state)))
+                jax.tree.map(opt_subtree_shardings, state.opt_state,
+                             is_leaf=params_like)))
 
     def place_data(data):
         return jax.device_put(data, data_sharding)
@@ -168,7 +186,8 @@ def build_pjit_epoch_fn(model, loss, tx: optax.GradientTransformation,
 def stage_steps(dataset, features_col: str, label_col: str, batch_size: int,
                 max_steps: Optional[int] = None) -> tuple:
     """[steps, batch, ...] arrays from a Dataset (global batch; the mesh
-    shards the batch dim over workers at device_put)."""
+    shards the batch dim over workers at device_put). Whole-epoch-resident;
+    see :func:`stage_step_chunks` for O(chunk) staging."""
     n = len(dataset)
     steps = n // batch_size
     if max_steps is not None:
@@ -183,3 +202,29 @@ def stage_steps(dataset, features_col: str, label_col: str, batch_size: int,
 
     return {"features": stack(features_col),
             "labels": stack(label_col)}, steps
+
+
+def stage_step_chunks(dataset, features_col: str, label_col: str,
+                      batch_size: int, chunk_steps: Optional[int] = None,
+                      max_steps: Optional[int] = None):
+    """Yield ``(host_data, steps)`` chunks of at most ``chunk_steps`` steps,
+    keeping staging memory O(chunk) instead of O(epoch). The caller places
+    each chunk with the epoch fn's ``place_data`` (an async ``device_put``),
+    so staging chunk *i+1* overlaps compute on chunk *i*. The final chunk
+    may be ragged (one extra compilation)."""
+    n = len(dataset)
+    steps = n // batch_size
+    if max_steps is not None:
+        steps = min(steps, max_steps)
+    if steps == 0:
+        raise ValueError(f"{n} rows cannot form one batch of {batch_size}")
+    if chunk_steps is None:
+        chunk_steps = steps
+    arrs = {"features": np.asarray(dataset[features_col]),
+            "labels": np.asarray(dataset[label_col])}
+    for start in range(0, steps, chunk_steps):
+        cnt = min(chunk_steps, steps - start)
+        lo = start * batch_size
+        hi = lo + cnt * batch_size
+        yield {key: a[lo:hi].reshape((cnt, batch_size) + a.shape[1:])
+               for key, a in arrs.items()}, cnt

@@ -62,7 +62,12 @@ class ModelPredictor(Predictor):
             self._num_shards = 1
 
     def predict(self, dataset: Dataset) -> Dataset:
-        x = np.asarray(dataset[self.features_col], np.float32)
+        # Preserve the column dtype: integer columns are token ids (BERT/GPT
+        # style models) and must reach the embedding lookup un-cast; only
+        # float columns are normalized to float32.
+        x = np.asarray(dataset[self.features_col])
+        if np.issubdtype(x.dtype, np.floating):
+            x = x.astype(np.float32)
         n = len(x)
         # pad to a full (batch * shards) multiple: static shapes, all chips busy
         chunk = self.batch_size * self._num_shards

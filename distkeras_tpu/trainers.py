@@ -75,8 +75,25 @@ class Trainer:
     def _maybe_resume(ckpt, like: dict, resume: bool) -> tuple:
         """(state_dict, start_epoch): restore the latest epoch checkpoint if
         asked and present. History is NOT checkpointed — a resumed trainer's
-        history covers only the epochs it ran."""
-        if ckpt is None or not resume or ckpt.latest_step() is None:
+        history covers only the epochs it ran.
+
+        A pre-existing non-empty checkpoint dir with ``resume=False`` is an
+        ERROR: Orbax skips saves for steps that already exist, so keeping
+        the stale steps would make the fresh run's snapshots silent no-ops
+        (and a crash retry would then resume the stale previous run), while
+        deleting them silently would destroy a prior run's checkpoints."""
+        if ckpt is None:
+            return like, 0
+        if not resume:
+            if ckpt.latest_step() is not None:
+                raise ValueError(
+                    f"checkpoint_dir {ckpt.directory!r} already contains "
+                    f"steps {ckpt.all_steps()} but resume=False. Pass "
+                    "resume=True to continue that run, point checkpoint_dir "
+                    "at a fresh directory, or clear it explicitly "
+                    "(distkeras_tpu.checkpoint.Checkpointer(dir).clear())")
+            return like, 0
+        if ckpt.latest_step() is None:
             return like, 0
         step = ckpt.latest_step()
         return ckpt.restore(like=like), step + 1
@@ -151,6 +168,7 @@ class DistributedTrainer(Trainer):
                  master_port: Optional[int] = None,  # parity no-op
                  mesh=None, seed: int = 0, mode: str = "sync",
                  checkpoint_dir: Optional[str] = None,
+                 staging_rounds: Optional[int] = None,
                  **strategy_kwargs):
         super().__init__(model, loss, worker_optimizer, learning_rate,
                          metrics, features_col, label_col, batch_size,
@@ -176,6 +194,10 @@ class DistributedTrainer(Trainer):
                 num_workers)
             self.num_workers = self.mesh.shape[mesh_lib.WORKER_AXIS]
         self.communication_window = int(communication_window)
+        # None: stage the whole epoch device-resident (fastest for data that
+        # fits). An int bounds staging memory to O(staging_rounds) with
+        # double-buffered host->device transfer (see stage_epoch_chunks).
+        self.staging_rounds = staging_rounds
         self.strategy = self._make_strategy(**strategy_kwargs)
         if mode == "host_async" and not self.strategy.exchanges:
             raise ValueError(
@@ -225,6 +247,11 @@ class DistributedTrainer(Trainer):
                 raise ValueError(
                     "checkpoint_dir is not supported in host_async mode "
                     "(no epoch barrier to snapshot at); use mode='sync'")
+            if self.staging_rounds is not None:
+                raise ValueError(
+                    "staging_rounds is not supported in host_async mode "
+                    "(worker threads stage their shards host-resident); "
+                    "use mode='sync' for O(chunk) staging")
             return self._train_host_async(dataset, shuffle)
         self._start()
         self._check_trainable(
@@ -243,19 +270,34 @@ class DistributedTrainer(Trainer):
         self.staleness_history = []
         round_offset = int(np.asarray(snap["counters"])[0])
         self.num_updates = int(np.asarray(snap["counters"])[1])
-        staged = None  # shuffle=False: stage the (identical) epoch data once
+        staged = None  # shuffle=False + whole-epoch staging: stage once
         for epoch in range(start_epoch, self.num_epoch):
-            if shuffle or staged is None:
+            # One code path for both staging modes: staging_rounds=None is
+            # the single-chunk case of the generator (whole epoch resident,
+            # reusable across epochs when not shuffling). With a chunk
+            # bound, the (async) epoch fn is dispatched on chunk i before
+            # chunk i+1 is pulled, so host slicing + device_put overlap
+            # compute; metric fetches are deferred to the epoch end so they
+            # don't serialize the chunks.
+            if staged is not None:
+                chunks = staged
+            else:
                 ds = dataset.shuffle(self.seed + epoch) if shuffle else dataset
-                staged = substrate.stage_epoch_data(
+                chunks = substrate.stage_epoch_chunks(
                     ds.repartition(self.num_workers), self.features_col,
                     self.label_col, self.batch_size,
-                    self.communication_window, self.mesh)
-            data, rounds = staged
-            center, carries, ms = epoch_fn(center, carries, data,
-                                           np.int32(round_offset))
-            round_offset += rounds
-            self._record(jax.device_get(ms), rounds)
+                    self.communication_window, self.mesh,
+                    chunk_rounds=self.staging_rounds)
+                if not shuffle and self.staging_rounds is None:
+                    staged = chunks = list(chunks)
+            pending = []
+            for data, rounds in chunks:
+                center, carries, ms = epoch_fn(center, carries, data,
+                                               np.int32(round_offset))
+                round_offset += rounds
+                pending.append((ms, rounds))
+            for ms, rounds in pending:
+                self._record(jax.device_get(ms), rounds)
             if ckpt is not None:
                 ckpt.save(epoch, {"center": center, "carries": carries,
                                   "counters": np.array(
@@ -406,7 +448,8 @@ class PjitTrainer(Trainer):
                  num_workers: Optional[int] = None,
                  model_parallelism: int = 1, partition_rules=None,
                  mesh=None, seed: int = 0,
-                 checkpoint_dir: Optional[str] = None):
+                 checkpoint_dir: Optional[str] = None,
+                 staging_steps: Optional[int] = None):
         super().__init__(model, loss, worker_optimizer, learning_rate,
                          metrics, features_col, label_col, batch_size,
                          num_epoch, seed, checkpoint_dir=checkpoint_dir)
@@ -416,6 +459,9 @@ class PjitTrainer(Trainer):
             num_workers, model_parallelism=model_parallelism)
         self.num_workers = self.mesh.shape[mesh_lib.WORKER_AXIS]
         self.partition_rules = partition_rules
+        # None: whole epoch device-resident; int: O(staging_steps) chunks
+        # with double-buffered device_put (see tensor.stage_step_chunks).
+        self.staging_steps = staging_steps
         if self.batch_size % self.num_workers != 0:
             raise ValueError(
                 f"batch_size {self.batch_size} must be divisible by "
@@ -439,21 +485,33 @@ class PjitTrainer(Trainer):
             resume)
         state = snap["state"]
         self.history = []
-        staged = None
+        staged = None  # shuffle=False + whole-epoch staging: place once
         step_offset = int(np.asarray(snap["counters"])[0])
         for epoch in range(start_epoch, self.num_epoch):
-            if shuffle or staged is None:
+            # Same single code path as DistributedTrainer.train: the
+            # staging_steps=None default is the one-chunk case, cached
+            # across epochs when not shuffling.
+            if staged is not None:
+                chunks = staged
+            else:
                 ds = dataset.shuffle(self.seed + epoch) if shuffle else dataset
-                data, steps = tensor.stage_steps(
-                    ds, self.features_col, self.label_col, self.batch_size)
-                staged = (place_data(data), steps)
-            data, steps = staged
-            state, ms = epoch_fn(state, data, np.int32(step_offset))
-            step_offset += steps
-            host = jax.device_get(ms)
-            self.history.extend(
-                {k: float(v[i]) for k, v in host.items()}
-                for i in range(steps))
+                chunks = ((place_data(data), steps)
+                          for data, steps in tensor.stage_step_chunks(
+                              ds, self.features_col, self.label_col,
+                              self.batch_size,
+                              chunk_steps=self.staging_steps))
+                if not shuffle and self.staging_steps is None:
+                    staged = chunks = list(chunks)
+            pending = []
+            for data, steps in chunks:
+                state, ms = epoch_fn(state, data, np.int32(step_offset))
+                step_offset += steps
+                pending.append((ms, steps))
+            for ms, steps in pending:
+                host = jax.device_get(ms)
+                self.history.extend(
+                    {k: float(v[i]) for k, v in host.items()}
+                    for i in range(steps))
             if ckpt is not None:
                 ckpt.save(epoch, {"state": state,
                                   "counters": np.array([step_offset],

@@ -7,9 +7,14 @@ scales each worker's commit by 1/(staleness+1).
 Run: python examples/bert_mlm_dynsgd.py [num_workers] [tiny|base]
 """
 
+import os
 import sys
 
-sys.path.insert(0, ".")
+try:
+    import distkeras_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # running from a source checkout: use the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
 
 import numpy as np
 

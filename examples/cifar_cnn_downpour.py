@@ -7,9 +7,14 @@ reference's convnet notebooks do.
 Run: python examples/cifar_cnn_downpour.py [num_workers]
 """
 
+import os
 import sys
 
-sys.path.insert(0, ".")
+try:
+    import distkeras_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # running from a source checkout: use the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
 
 import numpy as np
 

@@ -7,9 +7,14 @@ Peak attention memory per device: O((T/P)^2) instead of O(T^2).
 Run: python examples/long_context_gpt.py [seq_parallelism] [seq_len]
 """
 
+import os
 import sys
 
-sys.path.insert(0, ".")
+try:
+    import distkeras_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # running from a source checkout: use the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
 
 import numpy as np
 import optax

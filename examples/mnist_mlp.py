@@ -14,9 +14,14 @@ Run:  python examples/mnist_mlp.py [trainer] [num_workers]
                   aeasgd, eamsgd, downpour-async, ...}
 """
 
+import os
 import sys
 
-sys.path.insert(0, ".")  # repo-root execution
+try:
+    import distkeras_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # running from a source checkout: use the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
 
 import numpy as np
 

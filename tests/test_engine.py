@@ -76,3 +76,30 @@ def test_sparse_equals_dense_crossentropy():
     a = losses.categorical_crossentropy(logits, onehot)
     b = losses.sparse_categorical_crossentropy(logits, idx)
     np.testing.assert_allclose(float(a), float(b), rtol=1e-6)
+
+
+def test_sown_aux_losses_fold_into_objective():
+    """make_loss_fn must add 'losses'-collection sows (MoE load balance) to
+    the objective — silently dropping them de-balances every MoE trainer."""
+    import flax.linen as nn
+
+    class Sower(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            y = nn.Dense(4)(x)
+            self.sow("losses", "aux", jnp.asarray(0.25))
+            return y
+
+    model = Sower()
+    x = jnp.ones((2, 3))
+    params = model.init(jax.random.key(0), x)["params"]
+    batch = {"features": x, "labels": jax.nn.one_hot(jnp.array([0, 1]), 4)}
+
+    base_logits = model.apply({"params": params}, x)
+    base = losses.get("categorical_crossentropy")(
+        base_logits, batch["labels"])
+    total, logits = engine.make_loss_fn(
+        model, "categorical_crossentropy")(params, batch)
+    np.testing.assert_allclose(float(total), float(base) + 0.25, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(base_logits),
+                               rtol=1e-6)

@@ -78,3 +78,27 @@ def test_loss_evaluator():
         "label": np.eye(2, dtype=np.float32)[[0, 1]],
     })
     assert LossEvaluator().evaluate(ds) < 1e-3
+
+
+def test_model_predictor_preserves_integer_token_ids():
+    """Token-id models (BERT/GPT) must receive ids un-cast: a float32 cast
+    corrupts ids >= 2^24 and breaks integer embedding lookups."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class TokenModel(nn.Module):
+        @nn.compact
+        def __call__(self, ids, train=False):
+            emb = nn.Embed(num_embeddings=64, features=8)(ids)
+            return nn.Dense(4)(emb.mean(axis=1))
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 64, (100, 12)).astype(np.int32)
+    ds = Dataset({"features": ids})
+    model = TokenModel()
+    params = model.init(jax.random.key(0), jnp.asarray(ids[:2]))["params"]
+
+    out = ModelPredictor(model, params, batch_size=32).predict(ds)
+    direct = model.apply({"params": params}, jnp.asarray(ids))
+    np.testing.assert_allclose(out["prediction"], np.asarray(direct),
+                               rtol=2e-5, atol=2e-5)

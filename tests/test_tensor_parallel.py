@@ -91,3 +91,44 @@ def test_pjit_trainer_vit_tp():
 def test_pjit_batch_divisibility_check():
     with pytest.raises(ValueError, match="divisible"):
         PjitTrainer(MLP(), batch_size=30, num_workers=8)
+
+
+def test_opt_state_sharding_is_structural_not_shape_keyed():
+    """Two same-shaped params with DIFFERENT partition specs: each adam
+    moment must take its own param's spec (shape-keyed mapping collides)."""
+    import flax.linen as nn
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from distkeras_tpu import engine
+    from distkeras_tpu.parallel import mesh as mesh_lib, tensor
+
+    class TwoSquare(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.Dense(16, name="colp")(x)   # column-parallel
+            x = nn.Dense(16, name="rowp")(x)   # row-parallel, same shape
+            return x
+
+    rules = ((r"colp/kernel$", P(None, "model")),
+             (r"rowp/kernel$", P("model", None)))
+    mesh = mesh_lib.make_mesh(num_workers=2, model_parallelism=4)
+    model = TwoSquare()
+    tx = optax.adam(1e-3)
+    state = engine.create_train_state(
+        model, jax.random.key(0), {"features": jnp.ones((2, 16))}, tx)
+    _, place_state, _ = tensor.build_pjit_epoch_fn(
+        model, "mse", tx, mesh, (), rules)
+    placed = place_state(state)
+
+    def spec_of(tree, name):
+        return tree[name]["kernel"].sharding.spec
+
+    assert spec_of(placed.params, "colp") == P(None, "model")
+    assert spec_of(placed.params, "rowp") == P("model", None)
+    mu = placed.opt_state[0].mu
+    nu = placed.opt_state[0].nu
+    assert spec_of(mu, "colp") == P(None, "model")
+    assert spec_of(mu, "rowp") == P("model", None)
+    assert spec_of(nu, "colp") == P(None, "model")
+    assert spec_of(nu, "rowp") == P("model", None)

@@ -82,3 +82,39 @@ def test_host_async_rejects_checkpoint_dir(tmp_path):
                  checkpoint_dir=str(tmp_path / "d"))
     with pytest.raises(ValueError, match="host_async"):
         t.train(synthetic_mnist(n=256))
+
+
+def test_fresh_run_on_stale_checkpoint_dir_raises(tmp_path):
+    """resume=False with a pre-existing checkpoint dir must NOT proceed:
+    Orbax skips saves for steps that already exist, so the fresh run's
+    snapshots would be silent no-ops and a crash-retry would resume the
+    stale previous run. (Silently deleting the old run would be data loss,
+    so the trainer refuses instead.)"""
+    from distkeras_tpu.checkpoint import Checkpointer
+
+    ds = synthetic_mnist(n=256)
+    kw = dict(worker_optimizer="sgd", learning_rate=0.05, batch_size=64,
+              checkpoint_dir=str(tmp_path / "e"))
+
+    SingleTrainer(_model(), num_epoch=1, seed=1, **kw).train(ds)
+    second = SingleTrainer(_model(), num_epoch=1, seed=2, **kw)
+    with pytest.raises(ValueError, match="resume=False"):
+        second.train(ds)
+
+    # after an explicit clear, the fresh run saves its own state
+    Checkpointer(kw["checkpoint_dir"]).clear()
+    p_second = second.train(ds)
+    ckpt = Checkpointer(kw["checkpoint_dir"])
+    like = {"state": second._init_params(ds)}
+    restored = ckpt.restore(like=like)["state"].params
+    _params_equal(p_second, restored)
+    ckpt.close()
+
+
+def test_host_async_rejects_staging_rounds():
+    from distkeras_tpu import DOWNPOUR
+
+    t = DOWNPOUR(_model(), mode="host_async", num_workers=2,
+                 staging_rounds=4)
+    with pytest.raises(ValueError, match="staging_rounds"):
+        t.train(synthetic_mnist(n=256))
