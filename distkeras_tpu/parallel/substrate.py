@@ -41,6 +41,13 @@ def build_epoch_fn(model, loss, tx: optax.GradientTransformation,
                    dropout_seed: int = 0) -> Callable:
     """Compile the per-epoch distributed training function.
 
+    ``num_workers`` is the LOGICAL worker count K; when it exceeds the mesh's
+    ``workers`` axis size D, each device runs K/D stacked replicas (the
+    reference's ``parallelism_factor`` oversubscription: more partitions than
+    executors). Logical worker k lives on device k // (K/D); the staleness
+    rotation and the center fold run over all K, so K workers on D devices
+    compute the same training trajectory as K workers on K devices.
+
     Returns ``epoch_fn(center, carries, data, round_offset) ->
     (center, carries, metrics)`` where
 
@@ -55,23 +62,32 @@ def build_epoch_fn(model, loss, tx: optax.GradientTransformation,
     grad_fn = engine.make_grad_fn(model, loss)
     metric_names = tuple(metrics)
     base_key = jax.random.key(dropout_seed)
+    mesh_workers = mesh.shape[WORKERS]
+    if num_workers % mesh_workers != 0:
+        raise ValueError(
+            f"num_workers={num_workers} must be a multiple of the mesh's "
+            f"workers axis ({mesh_workers}); pick parallelism_factor so "
+            f"logical workers divide evenly onto devices")
+    factor = num_workers // mesh_workers
 
     def worker_epoch(center, carry, data, round_offset):
-        # Per-device blocks arrive with the leading workers axis of size 1.
-        carry = jax.tree.map(lambda x: x[0], carry)
-        data = jax.tree.map(lambda x: x[0], data)
-        k = jax.lax.axis_index(WORKERS)
+        # Per-device blocks arrive with a leading axis of `factor` logical
+        # workers (size 1 without oversubscription).
+        d = jax.lax.axis_index(WORKERS)
+        ks = d * factor + jnp.arange(factor, dtype=jnp.int32)
+        # scan wants rounds leading; the staged layout is workers-leading.
+        data = jax.tree.map(lambda x: jnp.moveaxis(x, 0, 1), data)
         num_rounds = jax.tree.leaves(data)[0].shape[0]
 
-        def one_round(state, xs):
-            center, carry = state
-            r_idx, batches = xs
-            carry = strategy.round_start(carry, center)
+        def run_worker(k, carry, batches):
+            """One logical worker's round: pull, window of steps, commit."""
+            carry = strategy.round_start(carry, self_center)
 
             def one_step(c, step_xs):
                 batch, i = step_xs
                 rng = jax.random.fold_in(
-                    jax.random.fold_in(jax.random.fold_in(base_key, k), r_idx), i)
+                    jax.random.fold_in(jax.random.fold_in(base_key, k),
+                                       self_round), i)
                 c, m = strategy.local_step(grad_fn, tx, c, batch,
                                            rngs={"dropout": rng})
                 out = {"loss": m["loss"]}
@@ -82,25 +98,41 @@ def build_epoch_fn(model, loss, tx: optax.GradientTransformation,
 
             step_idx = jnp.arange(window, dtype=jnp.int32)
             carry, step_ms = jax.lax.scan(one_step, carry, (batches, step_idx))
+            if not strategy.exchanges:
+                step_ms["staleness"] = jnp.float32(0.0)
+                return carry, step_ms, ()
+            commit = strategy.commit(carry, self_center, window)
+            position = (k + self_round) % num_workers
+            weighted = tree_scale(commit, strategy.staleness_weight(position))
+            step_ms["staleness"] = position.astype(jnp.float32)
+            return carry, step_ms, (weighted, commit)
+
+        def one_round(state, xs):
+            nonlocal self_center, self_round
+            center, carry = state
+            r_idx, batches = xs
+            self_center, self_round = center, r_idx
+            carry, step_ms, ex = jax.vmap(run_worker)(ks, carry, batches)
             if strategy.exchanges:
-                commit = strategy.commit(carry, center, window)
-                position = (k + r_idx) % num_workers
-                weight = strategy.staleness_weight(position)
-                total = jax.lax.psum(tree_scale(commit, weight), WORKERS)
-                new_center = tree_add(center, total)
-                carry = strategy.post_commit(carry, commit, new_center)
-                step_ms["staleness"] = position.astype(jnp.float32)
+                weighted, commits = ex
+                # fold: sum this device's replicas, then psum across devices
+                local = jax.tree.map(lambda x: jnp.sum(x, axis=0), weighted)
+                new_center = tree_add(center, jax.lax.psum(local, WORKERS))
+                carry = jax.vmap(
+                    lambda c, cm: strategy.post_commit(c, cm, new_center)
+                )(carry, commits)
             else:
                 new_center = center
-                step_ms["staleness"] = jnp.float32(0.0)
             return (new_center, carry), step_ms
 
+        # run_worker reads the round's center/index through these cells so it
+        # can be a single vmappable callable for both strategy families.
+        self_center = self_round = None
         rounds = round_offset + jnp.arange(num_rounds, dtype=jnp.int32)
         (center, carry), ms = jax.lax.scan(one_round, (center, carry),
                                            (rounds, data))
-        # Restore the size-1 workers axis for the sharded outputs.
-        carry = jax.tree.map(lambda x: x[None], carry)
-        ms = jax.tree.map(lambda x: x[None], ms)
+        # outputs go back workers-leading for the sharded out_specs
+        ms = jax.tree.map(lambda x: jnp.moveaxis(x, 0, 1), ms)
         return center, carry, ms
 
     shmapped = jax.shard_map(

@@ -165,6 +165,7 @@ class DistributedTrainer(Trainer):
                  label_col="label", batch_size: int = 32, num_epoch: int = 1,
                  num_workers: Optional[int] = None,
                  communication_window: int = 5,
+                 parallelism_factor: int = 1,
                  master_port: Optional[int] = None,  # parity no-op
                  mesh=None, seed: int = 0, mode: str = "sync",
                  checkpoint_dir: Optional[str] = None,
@@ -179,6 +180,9 @@ class DistributedTrainer(Trainer):
             raise ValueError(f"mode must be 'sync' or 'host_async', "
                              f"got {mode!r}")
         self.mode = mode
+        self.parallelism_factor = int(parallelism_factor)
+        if self.parallelism_factor < 1:
+            raise ValueError("parallelism_factor must be >= 1")
         if mode == "host_async":
             # thread-per-worker against a live PS; no mesh sharding involved
             if mesh is not None:
@@ -188,11 +192,16 @@ class DistributedTrainer(Trainer):
             self.mesh = None
             if num_workers is None:
                 raise ValueError("host_async mode needs explicit num_workers")
-            self.num_workers = int(num_workers)
+            # host threads oversubscribe a chip natively; the factor just
+            # multiplies the thread count (reference: partitions per worker)
+            self.num_workers = int(num_workers) * self.parallelism_factor
         else:
             self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(
                 num_workers)
-            self.num_workers = self.mesh.shape[mesh_lib.WORKER_AXIS]
+            # K logical workers = factor x mesh devices; each device runs
+            # `factor` stacked replicas (see substrate.build_epoch_fn)
+            self.num_workers = (self.mesh.shape[mesh_lib.WORKER_AXIS]
+                                * self.parallelism_factor)
         self.communication_window = int(communication_window)
         # None: stage the whole epoch device-resident (fastest for data that
         # fits). An int bounds staging memory to O(staging_rounds) with

@@ -1,0 +1,76 @@
+"""Sync-mode oversubscription (``parallelism_factor``): K logical workers on
+D devices must compute the same training trajectory as K workers on K
+devices. Reference parity: the partitions-per-worker knob of
+``AsynchronousDistributedTrainer`` (SURVEY.md §2 — unverified, mount empty).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distkeras_tpu import ADAG, DOWNPOUR, AEASGD, DynSGD
+from distkeras_tpu.data.dataset import synthetic_mnist
+from distkeras_tpu.models.mlp import MLP
+from distkeras_tpu.parallel import mesh as mesh_lib
+
+
+def _model():
+    return MLP(features=(32,), num_classes=10)
+
+
+KW = dict(loss="categorical_crossentropy", learning_rate=0.05,
+          batch_size=16, num_epoch=1, communication_window=2, metrics=())
+
+
+def _mesh(n):
+    return mesh_lib.make_mesh(num_workers=n, devices=jax.devices()[:n])
+
+
+@pytest.mark.parametrize("cls,extra", [
+    (DOWNPOUR, {}),
+    (DynSGD, {}),
+    (AEASGD, {"rho": 1.0}),
+])
+def test_oversubscribed_matches_fully_populated(cls, extra):
+    """K=8 on a 4-device mesh (factor 2) == K=8 on an 8-device mesh."""
+    ds = synthetic_mnist(n=1024, seed=0)
+    full = cls(_model(), mesh=_mesh(8), **KW, **extra)
+    over = cls(_model(), mesh=_mesh(4), parallelism_factor=2, **KW, **extra)
+    assert full.num_workers == over.num_workers == 8
+    p_full = full.train(ds)
+    p_over = over.train(ds)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6),
+        p_full, p_over)
+    # same logical rotation -> identical staleness bookkeeping
+    np.testing.assert_allclose(full.staleness_history, over.staleness_history)
+    # and identical per-step loss trajectories (worker-averaged history)
+    np.testing.assert_allclose(
+        [h["loss"] for h in full.get_history()],
+        [h["loss"] for h in over.get_history()], rtol=2e-5, atol=1e-6)
+
+
+def test_factor_multiplies_logical_workers():
+    t = ADAG(_model(), mesh=_mesh(4), parallelism_factor=4, **KW)
+    assert t.num_workers == 16
+    ds = synthetic_mnist(n=2048, seed=1)
+    t.train(ds)
+    # rotation over K=16: mean staleness (K-1)/2
+    assert np.allclose(np.mean(t.staleness_history), 7.5)
+    assert t.num_updates > 0
+
+
+def test_indivisible_factor_rejected():
+    from distkeras_tpu.parallel import substrate
+    from distkeras_tpu.ops import optimizers as opt_lib
+    from distkeras_tpu.parallel import strategies
+
+    with pytest.raises(ValueError, match="multiple"):
+        substrate.build_epoch_fn(
+            _model(), "categorical_crossentropy", opt_lib.get("sgd", 0.01),
+            strategies.get("downpour"), _mesh(4), num_workers=6, window=2)
+
+
+def test_bad_factor_rejected():
+    with pytest.raises(ValueError):
+        DOWNPOUR(_model(), parallelism_factor=0, **KW)
