@@ -33,9 +33,13 @@ def run(batch_size: int, image_side: int, window: int, rounds: int,
     mesh = mesh_lib.make_mesh(num_workers=1, devices=jax.devices()[:1])
     if tiny:
         model = ResNet(stage_sizes=(1, 1), block=BasicBlock, width=8,
-                       num_classes=num_classes, dtype=jnp.float32)
+                       num_classes=num_classes, dtype=jnp.float32,
+                       norm="nf")
     else:
-        model = resnet50(num_classes=num_classes)
+        # norm-free (scaled-WS) variant: the round-3 profile showed the GN
+        # step HBM-bound on activation-norm traffic (DESIGN.md); NF removes
+        # it and buys ~+12 MFU points on v5e.
+        model = resnet50(num_classes=num_classes, norm="nf")
     tx = opt_lib.get("sgd", 0.05)
     strategy = strategies.get("adag", learning_rate=0.05)
 
@@ -50,13 +54,15 @@ def run(batch_size: int, image_side: int, window: int, rounds: int,
         num_workers=1, window=window, metrics=())
 
     rng_np = np.random.default_rng(0)
-    feats = rng_np.standard_normal(
-        (1, rounds, window, batch_size, image_side, image_side, 3)
-    ).astype(np.float32)
+    # uint8 images, normalized on device — the realistic ImageNet input
+    # path: 4x fewer staged HBM bytes than f32 (and 4x less host->device)
+    feats = rng_np.integers(
+        0, 256, (rounds, 1, window, batch_size, image_side, image_side, 3),
+        dtype=np.uint8)
     labels = np.eye(num_classes, dtype=np.float32)[
-        rng_np.integers(0, num_classes, (1, rounds, window, batch_size))]
+        rng_np.integers(0, num_classes, (rounds, 1, window, batch_size))]
     data = jax.device_put({"features": feats, "labels": labels},
-                          mesh_lib.worker_sharded(mesh))
+                          mesh_lib.round_major_sharded(mesh))
 
     # FLOPs of one epoch_fn call: analytic matmul/conv count from the jaxpr
     # (XLA cost_analysis underreports on this backend — see observability).
@@ -105,11 +111,12 @@ def run(batch_size: int, image_side: int, window: int, rounds: int,
 def main():
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu:
-        # rounds=6: amortize the per-call host/tunnel dispatch overhead
-        # (~130ms measured) across 48 scanned steps per device call
-        configs = [dict(batch_size=128, image_side=224, window=8, rounds=6,
+        # rounds=12: amortize the per-call host/tunnel dispatch overhead
+        # (~90ms measured) across 96 scanned steps per device call; uint8
+        # staging keeps the whole 12-round chunk at ~1.9 GB HBM
+        configs = [dict(batch_size=128, image_side=224, window=8, rounds=12,
                         num_classes=1000, tiny=False),
-                   dict(batch_size=64, image_side=224, window=8, rounds=6,
+                   dict(batch_size=64, image_side=224, window=8, rounds=12,
                         num_classes=1000, tiny=False)]
     else:
         configs = [dict(batch_size=8, image_side=32, window=2, rounds=2,

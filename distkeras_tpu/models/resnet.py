@@ -11,6 +11,14 @@ TPU-first design choices:
   GroupNorm is stateless, batch-size independent, and fuses into the conv
   epilogue. This keeps every train step a pure function — the property the
   whole substrate (shard_map + scanned rounds) relies on.
+- **Norm-free variant (``norm="nf"``)**: the round-3 profile (DESIGN.md)
+  showed the GN step is HBM-bandwidth-bound — activation-norm traffic rides
+  fused into the convs and caps MFU at ~38% even though the MXU is half
+  idle. Scaled Weight Standardization (NF-ResNet / NFNet recipe: standardize
+  the ~25M weights per fan-in, ~100MB of traffic, instead of re-reading GBs
+  of activations) removes that entirely; measured +10 MFU points on v5e.
+  Blocks stay identity-at-init via a zero-init gain on the last branch conv
+  (the analogue of the GN variant's zero-init scale).
 - bfloat16 compute / float32 params; float32 classifier head.
 """
 
@@ -18,9 +26,10 @@ from __future__ import annotations
 
 import math
 from functools import partial
-from typing import Any, Sequence
+from typing import Any, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 ModuleDef = Any
@@ -31,8 +40,10 @@ ModuleDef = Any
 #: bench): the per-sample-grid kernel LOST to XLA's native lowering
 #: (20.9% vs 34.7% MFU) because the custom call breaks fusion with the
 #: surrounding convs and the VMEM-overflow backward path costs extra
-#: passes. Kept as an experimental path (numerics fully tested); a
-#: two-stage tiled variant is the candidate fix.
+#: passes. The round-3 profile (DESIGN.md §4b) retired the kernel
+#: approach entirely: XLA already fuses GN stats into the producer convs,
+#: so no standalone kernel can win — use ``norm="nf"`` when norm traffic
+#: matters. Kept as an experimental path (numerics fully tested).
 USE_FUSED_GROUPNORM = False
 
 
@@ -50,15 +61,86 @@ def group_norm(channels: int, dtype, name: str, **kw):
     return nn.GroupNorm(num_groups=groups, dtype=dtype, name=name, **kw)
 
 
+#: variance compensation applied after branch-internal ReLUs of norm-free
+#: blocks. Mean-zero (weight-standardized) kernels propagate only the
+#: input's variance, and Var[relu(z)] = (1 - 1/pi)/2 for unit-normal z, so
+#: the NF-ResNet/NFNet gain is sqrt(2/(1 - 1/pi)) — not sqrt(2), which
+#: preserves the second moment rather than the variance.
+_RELU_GAIN = 1.7128585504496627
+
+
+class ScaledWSConv(nn.Module):
+    """Conv with Scaled Weight Standardization (NF-ResNet / NFNet recipe).
+
+    The kernel is standardized per output channel over its fan-in and scaled
+    by ``1/sqrt(fan_in)`` so unit-variance input yields ~unit-variance output
+    (gain 1); a learnable per-channel gain restores expressivity. All weight
+    math runs in f32 on the ~O(params) tensors, then the standardized kernel
+    is cast to the compute dtype — this replaces GroupNorm's per-step passes
+    over GBs of activations with ~100MB of weight traffic, which is what the
+    round-3 profile showed the step was bound by (DESIGN.md).
+    """
+
+    features: int
+    kernel_size: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: jnp.dtype = jnp.bfloat16
+    use_bias: bool = True
+    gain_init: Any = nn.initializers.ones
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        in_ch = x.shape[-1]
+        kernel = self.param("kernel", nn.initializers.normal(1.0),
+                            (kh, kw, in_ch, self.features), jnp.float32)
+        fan_in = kh * kw * in_ch
+        mu = jnp.mean(kernel, axis=(0, 1, 2), keepdims=True)
+        var = jnp.var(kernel, axis=(0, 1, 2), keepdims=True)
+        w = (kernel - mu) * jax.lax.rsqrt(var * fan_in + 1e-4)
+        gain = self.param("gain", self.gain_init, (self.features,),
+                          jnp.float32)
+        w = w * gain
+        y = jax.lax.conv_general_dilated(
+            x.astype(self.dtype), w.astype(self.dtype),
+            window_strides=self.strides, padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            b = self.param("bias", nn.initializers.zeros,
+                           (self.features,), jnp.float32)
+            y = y + b.astype(self.dtype)
+        return y
+
+
 class BottleneckBlock(nn.Module):
     """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut on shape change."""
 
     filters: int  # bottleneck width; block output is 4*filters
     strides: int = 1
     dtype: jnp.dtype = jnp.bfloat16
+    norm: str = "gn"  # "gn" | "nf" (norm-free, scaled-WS convs)
 
     @nn.compact
     def __call__(self, x):
+        if self.norm == "nf":
+            conv = partial(ScaledWSConv, dtype=self.dtype)
+            residual = x
+            y = conv(self.filters, (1, 1), name="conv1")(x)
+            y = nn.relu(y) * _RELU_GAIN
+            y = conv(self.filters, (3, 3),
+                     strides=(self.strides, self.strides),
+                     name="conv2")(y)
+            y = nn.relu(y) * _RELU_GAIN
+            # zero-init gain: the block starts as identity, same role as
+            # the GN variant's zero-init norm3 scale
+            y = conv(4 * self.filters, (1, 1), name="conv3",
+                     gain_init=nn.initializers.zeros)(y)
+            if residual.shape != y.shape:
+                residual = conv(4 * self.filters, (1, 1),
+                                strides=(self.strides, self.strides),
+                                name="proj")(residual)
+            return nn.relu(residual + y)
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
         norm = partial(group_norm, dtype=self.dtype)
         residual = x
@@ -87,9 +169,24 @@ class BasicBlock(nn.Module):
     filters: int
     strides: int = 1
     dtype: jnp.dtype = jnp.bfloat16
+    norm: str = "gn"
 
     @nn.compact
     def __call__(self, x):
+        if self.norm == "nf":
+            conv = partial(ScaledWSConv, dtype=self.dtype)
+            residual = x
+            y = conv(self.filters, (3, 3),
+                     strides=(self.strides, self.strides),
+                     name="conv1")(x)
+            y = nn.relu(y) * _RELU_GAIN
+            y = conv(self.filters, (3, 3), name="conv2",
+                     gain_init=nn.initializers.zeros)(y)
+            if residual.shape != y.shape:
+                residual = conv(self.filters, (1, 1),
+                                strides=(self.strides, self.strides),
+                                name="proj")(residual)
+            return nn.relu(residual + y)
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
         norm = partial(group_norm, dtype=self.dtype)
         residual = x
@@ -116,21 +213,34 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     width: int = 64
     dtype: jnp.dtype = jnp.bfloat16
+    norm: str = "gn"  # "gn" | "nf" (norm-free: scaled-WS convs, no GN)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         del train  # stateless norms: train/eval forward passes are identical
-        x = x.astype(self.dtype)
-        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
-                    use_bias=False, dtype=self.dtype, name="conv_stem")(x)
-        x = group_norm(self.width, dtype=self.dtype, name="norm_stem")(x)
-        x = nn.relu(x)
+        if x.dtype == jnp.uint8:
+            # on-device input normalization: the pipeline stages raw uint8
+            # images (4x fewer host->device and HBM bytes than f32)
+            x = (x.astype(self.dtype) - 127.5) / 58.0
+        else:
+            x = x.astype(self.dtype)
+        if self.norm == "nf":
+            x = ScaledWSConv(self.width, (7, 7), strides=(2, 2),
+                             padding=((3, 3), (3, 3)), dtype=self.dtype,
+                             name="conv_stem")(x)
+            x = nn.relu(x) * _RELU_GAIN
+        else:
+            x = nn.Conv(self.width, (7, 7), strides=(2, 2),
+                        padding=[(3, 3), (3, 3)],
+                        use_bias=False, dtype=self.dtype, name="conv_stem")(x)
+            x = group_norm(self.width, dtype=self.dtype, name="norm_stem")(x)
+            x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for i, num_blocks in enumerate(self.stage_sizes):
             for j in range(num_blocks):
                 strides = 2 if i > 0 and j == 0 else 1
                 x = self.block(filters=self.width * 2 ** i, strides=strides,
-                               dtype=self.dtype,
+                               dtype=self.dtype, norm=self.norm,
                                name=f"stage{i}_block{j}")(x)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)

@@ -50,6 +50,16 @@ def worker_sharded(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(WORKER_AXIS))
 
 
+def round_major_sharded(mesh: Mesh) -> NamedSharding:
+    """Shard axis 1 (workers) of round-major staged data.
+
+    Epoch data is staged as (rounds, workers, window, batch, ...) — rounds
+    leading, exactly the layout ``lax.scan`` consumes — so the device never
+    materializes a transposed copy of the whole chunk (it would, briefly
+    doubling data HBM, if staging were worker-major)."""
+    return NamedSharding(mesh, P(None, WORKER_AXIS))
+
+
 def put_replicated(tree, mesh: Mesh):
     return jax.device_put(tree, replicated(mesh))
 

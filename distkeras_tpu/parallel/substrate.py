@@ -53,7 +53,9 @@ def build_epoch_fn(model, loss, tx: optax.GradientTransformation,
 
     - ``center``: replicated params pytree (the parameter server state),
     - ``carries``: per-worker Carry pytree with leading ``num_workers`` axis,
-    - ``data``: dict of arrays shaped (num_workers, rounds, window, batch, ...),
+    - ``data``: dict of arrays shaped (rounds, num_workers, window, batch,
+      ...) — round-major, the layout ``lax.scan`` consumes directly (see
+      :func:`mesh.round_major_sharded`),
     - ``round_offset``: int32 scalar, global round counter (continues the
       staleness rotation across epochs),
     - ``metrics``: dict of (num_workers, rounds, window) float arrays plus
@@ -71,23 +73,25 @@ def build_epoch_fn(model, loss, tx: optax.GradientTransformation,
     factor = num_workers // mesh_workers
 
     def worker_epoch(center, carry, data, round_offset):
-        # Per-device blocks arrive with a leading axis of `factor` logical
-        # workers (size 1 without oversubscription).
+        # Per-device data block: (rounds, factor, window, batch, ...) —
+        # round-major staging means lax.scan consumes axis 0 directly, no
+        # device-side transpose of the whole chunk. `factor` is this
+        # device's count of stacked logical workers (1 without
+        # oversubscription).
         d = jax.lax.axis_index(WORKERS)
         ks = d * factor + jnp.arange(factor, dtype=jnp.int32)
-        # scan wants rounds leading; the staged layout is workers-leading.
-        data = jax.tree.map(lambda x: jnp.moveaxis(x, 0, 1), data)
         num_rounds = jax.tree.leaves(data)[0].shape[0]
 
-        def run_worker(k, carry, batches):
-            """One logical worker's round: pull, window of steps, commit."""
-            carry = strategy.round_start(carry, self_center)
+        def run_worker(k, carry, batches, center, r_idx):
+            """One logical worker's round: pull, window of steps, commit.
+            ``center``/``r_idx`` are broadcast (vmap in_axes=None)."""
+            carry = strategy.round_start(carry, center)
 
             def one_step(c, step_xs):
                 batch, i = step_xs
                 rng = jax.random.fold_in(
                     jax.random.fold_in(jax.random.fold_in(base_key, k),
-                                       self_round), i)
+                                       r_idx), i)
                 c, m = strategy.local_step(grad_fn, tx, c, batch,
                                            rngs={"dropout": rng})
                 out = {"loss": m["loss"]}
@@ -101,18 +105,18 @@ def build_epoch_fn(model, loss, tx: optax.GradientTransformation,
             if not strategy.exchanges:
                 step_ms["staleness"] = jnp.float32(0.0)
                 return carry, step_ms, ()
-            commit = strategy.commit(carry, self_center, window)
-            position = (k + self_round) % num_workers
+            commit = strategy.commit(carry, center, window)
+            position = (k + r_idx) % num_workers
             weighted = tree_scale(commit, strategy.staleness_weight(position))
             step_ms["staleness"] = position.astype(jnp.float32)
             return carry, step_ms, (weighted, commit)
 
         def one_round(state, xs):
-            nonlocal self_center, self_round
             center, carry = state
             r_idx, batches = xs
-            self_center, self_round = center, r_idx
-            carry, step_ms, ex = jax.vmap(run_worker)(ks, carry, batches)
+            carry, step_ms, ex = jax.vmap(
+                run_worker, in_axes=(0, 0, 0, None, None))(
+                    ks, carry, batches, center, r_idx)
             if strategy.exchanges:
                 weighted, commits = ex
                 # fold: sum this device's replicas, then psum across devices
@@ -125,19 +129,17 @@ def build_epoch_fn(model, loss, tx: optax.GradientTransformation,
                 new_center = center
             return (new_center, carry), step_ms
 
-        # run_worker reads the round's center/index through these cells so it
-        # can be a single vmappable callable for both strategy families.
-        self_center = self_round = None
         rounds = round_offset + jnp.arange(num_rounds, dtype=jnp.int32)
         (center, carry), ms = jax.lax.scan(one_round, (center, carry),
                                            (rounds, data))
-        # outputs go back workers-leading for the sharded out_specs
+        # metrics go back workers-leading for the sharded out_specs (tiny
+        # arrays — this transpose is noise, unlike one on the data would be)
         ms = jax.tree.map(lambda x: jnp.moveaxis(x, 0, 1), ms)
         return center, carry, ms
 
     shmapped = jax.shard_map(
         worker_epoch, mesh=mesh,
-        in_specs=(P(), P(WORKERS), P(WORKERS), P()),
+        in_specs=(P(), P(WORKERS), P(None, WORKERS), P()),
         out_specs=(P(), P(WORKERS), P(WORKERS)),
         check_vma=False,
     )
@@ -163,7 +165,7 @@ def stage_epoch_data(shards, features_col: str, label_col: str,
                      batch_size: int, window: int, mesh: Mesh,
                      max_rounds: Optional[int] = None):
     """Host-side data staging: per-worker shards -> one sharded device array
-    shaped (workers, rounds, window, batch, ...).
+    shaped (rounds, workers, window, batch, ...).
 
     Every worker gets the same round count (static shapes — XLA's contract);
     the common count is the smallest shard's, surplus rows are dropped (the
@@ -205,16 +207,17 @@ def stage_epoch_chunks(shards, features_col: str, label_col: str,
     cols = {"features": features_col, "labels": label_col}
     arrs = {key: [np.asarray(s[col]) for s in shards]
             for key, col in cols.items()}
-    sharding = mesh_lib.worker_sharded(mesh)
+    sharding = mesh_lib.round_major_sharded(mesh)
     for start in range(0, rounds, chunk_rounds):
         cnt = min(chunk_rounds, rounds - start)
         lo = start * per_round
         hi = lo + cnt * per_round
 
         def stack(key):
+            # round-major: (rounds, workers, window, batch, ...)
             return np.stack([
                 a[lo:hi].reshape((cnt, window, batch_size) + a.shape[1:])
-                for a in arrs[key]])
+                for a in arrs[key]], axis=1)
 
         data = {key: stack(key) for key in cols}
         yield jax.device_put(data, sharding), cnt
