@@ -1,3 +1,4 @@
-from distkeras_tpu.data.dataset import Dataset, synthetic_mnist
+from distkeras_tpu.data.dataset import Dataset, ShardedColumn, synthetic_mnist
+from distkeras_tpu.data.prefetch import prefetch
 
-__all__ = ["Dataset", "synthetic_mnist"]
+__all__ = ["Dataset", "ShardedColumn", "prefetch", "synthetic_mnist"]

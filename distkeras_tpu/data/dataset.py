@@ -11,24 +11,89 @@ drop ragged tails — XLA requires fixed shapes).
 
 from __future__ import annotations
 
-from typing import Dict, Iterator, List, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
 
 from distkeras_tpu.utils import rng
 
 
+class ShardedColumn:
+    """Lazy concatenation of per-file array shards (memmaps stay on disk).
+
+    Presents just enough of the ndarray protocol for the data path: length,
+    shape/dtype, contiguous slicing (returns a trimmed *view* — no bytes
+    read), integer row access, and materialization via ``np.asarray``. The
+    staging layer slices chunks out of worker shards and materializes only
+    those, so an epoch never has to exist in host RAM at once.
+    """
+
+    def __init__(self, parts: Sequence[np.ndarray]):
+        if not parts:
+            raise ValueError("ShardedColumn needs at least one part")
+        tails = {p.shape[1:] for p in parts}
+        dtypes = {p.dtype for p in parts}
+        if len(tails) != 1 or len(dtypes) != 1:
+            raise ValueError(
+                f"Shard shape/dtype mismatch: shapes {sorted(tails)}, "
+                f"dtypes {sorted(map(str, dtypes))}")
+        self.parts = list(parts)
+        self._offsets = np.cumsum([0] + [len(p) for p in parts])
+
+    def __len__(self) -> int:
+        return int(self._offsets[-1])
+
+    @property
+    def shape(self):
+        return (len(self),) + self.parts[0].shape[1:]
+
+    @property
+    def dtype(self):
+        return self.parts[0].dtype
+
+    def __array__(self, dtype=None, copy=None):
+        out = np.concatenate([np.asarray(p) for p in self.parts])
+        return out.astype(dtype) if dtype is not None else out
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            lo, hi, step = key.indices(len(self))
+            if step != 1:
+                return np.asarray(self)[key]
+            views = []
+            for p, off in zip(self.parts, self._offsets[:-1]):
+                a, b = max(lo - off, 0), min(hi - off, len(p))
+                if a < b:
+                    views.append(p[a:b])
+            if not views:
+                views = [self.parts[0][:0]]
+            return views[0] if len(views) == 1 else ShardedColumn(views)
+        if np.isscalar(key) or isinstance(key, (int, np.integer)):
+            i = int(key) + (len(self) if key < 0 else 0)
+            part = int(np.searchsorted(self._offsets, i, side="right")) - 1
+            return self.parts[part][i - self._offsets[part]]
+        return np.asarray(self)[key]  # fancy indexing materializes
+
+
+ColumnLike = Union[np.ndarray, ShardedColumn]
+
+
 class Dataset:
     """An immutable set of equal-length named columns."""
 
-    def __init__(self, columns: Dict[str, np.ndarray]):
+    def __init__(self, columns: Dict[str, ColumnLike]):
         if not columns:
             raise ValueError("Dataset needs at least one column")
         n = {len(v) for v in columns.values()}
         if len(n) != 1:
             raise ValueError(f"Column length mismatch: "
                              f"{ {k: len(v) for k, v in columns.items()} }")
-        self._columns = {k: np.asarray(v) for k, v in columns.items()}
+        # ShardedColumns and memmaps pass through un-materialized (memmap
+        # is kept as its own type so laziness stays visible downstream)
+        self._columns = {
+            k: v if isinstance(v, (ShardedColumn, np.memmap))
+            else np.asarray(v)
+            for k, v in columns.items()}
 
     # -- basic accessors ----------------------------------------------------
     def __len__(self) -> int:
@@ -65,15 +130,20 @@ class Dataset:
         from distkeras_tpu.data import native
 
         perm = rng.permutation(seed, len(self))
-        return Dataset({k: native.gather_rows(v, perm)
+        # NB: a row gather materializes the whole dataset; for file-backed
+        # data prefer pre-shuffled shard files (see Dataset.from_files)
+        return Dataset({k: native.gather_rows(np.asarray(v), perm)
                         for k, v in self._columns.items()})
 
     def repartition(self, num_partitions: int) -> List["Dataset"]:
         """Split into contiguous near-equal shards (Spark repartition parity;
-        call shuffle() first for the randomized behavior)."""
-        idx = np.array_split(np.arange(len(self)), num_partitions)
-        return [Dataset({k: v[i] for k, v in self._columns.items()})
-                for i in idx]
+        call shuffle() first for the randomized behavior). Slice-based, so
+        shards of memmap/file-backed columns stay views — no bytes read."""
+        sizes = np.full(num_partitions, len(self) // num_partitions)
+        sizes[:len(self) % num_partitions] += 1  # np.array_split's split
+        bounds = np.cumsum(np.concatenate([[0], sizes]))
+        return [Dataset({k: v[lo:hi] for k, v in self._columns.items()})
+                for lo, hi in zip(bounds[:-1], bounds[1:])]
 
     def batches(self, batch_size: int, cols: Optional[Sequence[str]] = None,
                 drop_remainder: bool = True) -> Iterator[Dict[str, np.ndarray]]:
@@ -94,6 +164,33 @@ class Dataset:
     @staticmethod
     def from_arrays(**columns) -> "Dataset":
         return Dataset(columns)
+
+    @staticmethod
+    def from_files(columns: Dict[str, Union[str, Sequence[str]]],
+                   mmap: bool = True) -> "Dataset":
+        """File-backed dataset from ``.npy`` files: one path or a list of
+        shard paths per column (SURVEY §7's "input pipeline" hard part —
+        ImageNet-scale epochs must be feedable without host-RAM residency).
+
+        With ``mmap=True`` (default) every file is ``np.load``-ed with
+        ``mmap_mode="r"``: rows are read from disk only when a staging
+        chunk materializes them, so training streams the epoch in O(chunk)
+        host memory (`substrate.stage_epoch_chunks` + `staging_rounds=`).
+        Multi-file columns are presented as one logical column via
+        :class:`ShardedColumn` — shard boundaries need not align with
+        worker or chunk boundaries.
+
+        ``shuffle()`` on a file-backed dataset materializes it (row
+        gather); for big data, pre-shuffle the shard files instead.
+        """
+        cols: Dict[str, ColumnLike] = {}
+        mode = "r" if mmap else None
+        for name, paths in columns.items():
+            if isinstance(paths, (str, bytes)) or hasattr(paths, "__fspath__"):
+                paths = [paths]
+            parts = [np.load(p, mmap_mode=mode) for p in paths]
+            cols[name] = parts[0] if len(parts) == 1 else ShardedColumn(parts)
+        return Dataset(cols)
 
     @staticmethod
     def concat(parts: Sequence["Dataset"]) -> "Dataset":

@@ -1,0 +1,72 @@
+"""Background prefetch: a reader thread ahead of the device.
+
+The staging generators (`substrate.stage_epoch_chunks`,
+`tensor.stage_step_chunks`) do host-side work per chunk — disk reads for
+file-backed datasets, the O(chunk) stack/copy, and the (async) device_put
+dispatch. Running the generator on a daemon thread with a small bounded
+queue overlaps ALL of that with device compute on the previous chunk; the
+consumer just drains the queue. This is the TPU-native stand-in for the
+reference's Spark executors prefetching partition iterators.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, TypeVar
+
+T = TypeVar("T")
+
+_DONE = object()
+
+
+def prefetch(it: Iterable[T], depth: int = 1) -> Iterator[T]:
+    """Iterate ``it`` on a background thread, keeping up to ``depth`` items
+    queued. Exceptions raised by the producer re-raise at the consumer's
+    ``next()``; ordering is preserved.
+
+    Memory bound: at most ``depth + 1`` items exist beyond the one the
+    consumer holds (``depth`` queued plus one the blocked producer has
+    already built) — with the default ``depth=1`` that is classic double
+    buffering. If the consumer abandons the generator (break / exception),
+    its ``finally`` signals the producer, which drops its pending item and
+    exits instead of blocking forever holding device buffers.
+    """
+    if depth < 1:
+        raise ValueError(f"depth must be >= 1, got {depth}")
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    abandoned = threading.Event()
+
+    def _put(item) -> bool:
+        """put that gives up when the consumer is gone."""
+        while not abandoned.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def run():
+        try:
+            for item in it:
+                if not _put((False, item)):
+                    return
+        except BaseException as e:  # propagate, don't swallow
+            _put((True, e))
+            return
+        _put((False, _DONE))
+
+    thread = threading.Thread(target=run, daemon=True,
+                              name="distkeras-prefetch")
+    thread.start()
+    try:
+        while True:
+            is_err, item = q.get()
+            if is_err:
+                raise item
+            if item is _DONE:
+                return
+            yield item
+    finally:
+        abandoned.set()

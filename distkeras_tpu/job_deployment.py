@@ -38,11 +38,21 @@ def _trainers() -> dict:
     return _TRAINER_REGISTRY
 
 
+def _resolve(dotted: str) -> Callable:
+    module, _, attr = dotted.partition(":")
+    import importlib
+
+    return getattr(importlib.import_module(module), attr)
+
+
 class Job:
     """One training job: trainer name + kwargs + a data provider.
 
-    ``data`` may be a Dataset or a zero-arg callable returning one (so
-    punchcard JSON can name a loader by dotted path).
+    ``model`` may be a live module or a dotted ``"module:callable"`` path
+    (invoked with no args at run time); ``data`` may be a Dataset, a
+    zero-arg callable, or a dotted path. Dotted-path jobs are fully
+    declarative — they serialize to punchcard JSON and into launchable
+    bundles (:meth:`Punchcard.save_bundle`).
     """
 
     def __init__(self, job_name: str, trainer: str, model,
@@ -62,8 +72,12 @@ class Job:
 
     def run(self):
         cls = _trainers()[self.trainer_name]
-        trainer = cls(self.model, **self.trainer_kwargs)
-        dataset = self.data() if callable(self.data) else self.data
+        model = (_resolve(self.model)() if isinstance(self.model, str)
+                 else self.model)
+        trainer = cls(model, **self.trainer_kwargs)
+        data = (_resolve(self.data) if isinstance(self.data, str)
+                else self.data)
+        dataset = data() if callable(data) else data
         if not isinstance(dataset, Dataset):
             raise TypeError(f"Job data must resolve to a Dataset, "
                             f"got {type(dataset)}")
@@ -73,6 +87,24 @@ class Job:
         self.history = trainer.get_history()
         self.training_time = trainer.get_training_time()
         return self.result
+
+    def to_spec(self) -> dict:
+        """Declarative JSON spec of this job (punchcard/bundle format).
+
+        Requires dotted-path model/data — a live module or in-memory
+        Dataset cannot be handed to an external launcher honestly.
+        """
+        if not isinstance(self.model, str) or not isinstance(self.data, str):
+            raise TypeError(
+                f"Job {self.job_name!r} holds a live "
+                f"{'model' if not isinstance(self.model, str) else 'dataset'}"
+                "; bundles need dotted 'module:callable' paths for model "
+                "and data so any launcher can reconstruct them")
+        spec = {"job_name": self.job_name, "trainer": self.trainer_name,
+                "model": self.model, "data": self.data,
+                "shuffle": self.shuffle}
+        spec.update(self.trainer_kwargs)
+        return spec
 
     def describe(self) -> dict:
         return {"job_name": self.job_name, "trainer": self.trainer_name,
@@ -99,22 +131,20 @@ class Punchcard:
 
     @staticmethod
     def _resolve(dotted: str) -> Callable:
-        module, _, attr = dotted.partition(":")
-        import importlib
-
-        return getattr(importlib.import_module(module), attr)
+        return _resolve(dotted)
 
     @classmethod
     def _load(cls, path: str) -> list[Job]:
         with open(path) as f:
             specs = json.load(f)
-        jobs = []
+        # dotted paths stay strings (resolved lazily at run()) so a loaded
+        # punchcard re-serializes losslessly — but validate them NOW: a
+        # typo'd path in job 5 must fail at load, not after job 1-4 trained
         for spec in specs:
-            spec = dict(spec)
-            model = cls._resolve(spec.pop("model"))()
-            data = cls._resolve(spec.pop("data"))
-            jobs.append(Job(model=model, data=data, **spec))
-        return jobs
+            for key in ("model", "data"):
+                if isinstance(spec.get(key), str):
+                    _resolve(spec[key])
+        return [Job(**spec) for spec in specs]
 
     def submit(self, job: Job):
         self.jobs.append(job)
@@ -125,3 +155,62 @@ class Punchcard:
             job.run()
             self.results.append(job.describe())
         return self.results
+
+    def save_bundle(self, directory: str) -> str:
+        """Serialize a launchable job bundle: hand the directory to any
+        launcher (SURVEY §2 `job_deployment.py` — the reference submitted
+        jobs to a remote head node; zero-egress here, so the capability is
+        "everything a remote launcher needs, in one directory").
+
+        Contents: ``punchcard.json`` (declarative job specs),
+        ``run_punchcard.py`` (self-contained entry script), and
+        ``ENVIRONMENT.md`` (pinned interpreter + dependency versions).
+        Returns the directory path.
+        """
+        import os
+        import platform
+        from importlib import metadata
+
+        os.makedirs(directory, exist_ok=True)
+        specs = [job.to_spec() for job in self.jobs]
+        with open(os.path.join(directory, "punchcard.json"), "w") as f:
+            json.dump(specs, f, indent=2)
+
+        entry = (
+            '"""Launchable bundle entry: run the punchcard in this '
+            'directory."""\n'
+            "import json\n"
+            "import os\n"
+            "import sys\n\n"
+            "from distkeras_tpu.job_deployment import Punchcard\n\n"
+            'HERE = os.path.dirname(os.path.abspath(__file__))\n\n'
+            "def main():\n"
+            "    card = Punchcard(path=os.path.join(HERE, "
+            '"punchcard.json"))\n'
+            "    results = card.run()\n"
+            "    print(json.dumps(results, indent=2))\n"
+            "    return 0\n\n"
+            'if __name__ == "__main__":\n'
+            "    sys.exit(main())\n")
+        with open(os.path.join(directory, "run_punchcard.py"), "w") as f:
+            f.write(entry)
+
+        deps = []
+        for pkg in ("jax", "jaxlib", "flax", "optax", "orbax-checkpoint",
+                    "numpy", "distkeras-tpu"):
+            try:
+                deps.append(f"- {pkg}=={metadata.version(pkg)}")
+            except metadata.PackageNotFoundError:
+                deps.append(f"- {pkg} (not installed here; any compatible "
+                            "version)")
+        env = ("# Bundle environment\n\n"
+               f"Serialized on python {platform.python_version()} "
+               f"({platform.machine()}).\n\n"
+               "Launcher contract: `python run_punchcard.py` with the\n"
+               "`distkeras_tpu` package importable and the versions below\n"
+               "(or compatible) installed. Call\n"
+               "`distkeras_tpu.parallel.distributed.initialize()` first on\n"
+               "multi-host slices.\n\n" + "\n".join(deps) + "\n")
+        with open(os.path.join(directory, "ENVIRONMENT.md"), "w") as f:
+            f.write(env)
+        return directory

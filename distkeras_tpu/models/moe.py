@@ -106,6 +106,38 @@ class MoEEncoderBlock(nn.Module):
         return x + y
 
 
+class MoEClassifier(nn.Module):
+    """MoE encoder stack + classification head — the end-to-end trainable
+    EP model (dryrun + trainer-zoo tests train it; EP shardings from
+    :func:`ep_partition_rules`).
+
+    Input is [B, T, W] token features; output [B, num_classes] f32 logits.
+    The Switch aux losses sown by each block are folded into the objective
+    by ``engine.make_loss_fn`` — no trainer-specific wiring needed.
+    """
+
+    num_classes: int
+    num_layers: int = 1
+    num_heads: int = 2
+    num_experts: int = 4
+    mlp_dim: int = 32
+    capacity_factor: float = 2.0
+    dtype: jnp.dtype = jnp.bfloat16
+    aux_loss_weight: float = 0.01
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for i in range(self.num_layers):
+            x = MoEEncoderBlock(
+                num_heads=self.num_heads, num_experts=self.num_experts,
+                mlp_dim=self.mlp_dim, capacity_factor=self.capacity_factor,
+                dtype=self.dtype, aux_loss_weight=self.aux_loss_weight,
+                name=f"block{i}")(x, train=train)
+        x = jnp.mean(x.astype(jnp.float32), axis=1)  # pool over tokens
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
 # partition rule addition for EP: stack axis of expert params shards over
 # the model axis (see parallel/tensor.DEFAULT_RULES usage)
 EP_RULES = (

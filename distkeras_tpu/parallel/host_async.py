@@ -10,11 +10,16 @@ updates live between any two of a worker's steps. Staleness is real thread
 scheduling, not a rotation schedule.
 
 TPU mapping: each worker's window is ONE jitted scan (compiled once, shared
-by all workers); commits fold on-device via the jitted PS fold. Threads
-serialize on the chip at window granularity, which is exactly the interleaving
-the reference's executors had against the driver's lock — but with the center
-in HBM instead of driver RAM, and windows as compiled programs instead of
-eager Keras steps.
+by all workers), and each worker thread is PINNED to a device
+(``devices[k % D]``) — its carry and staged batches live there, it pulls
+the center across the interconnect, computes its window locally, and
+commits back to the center's device (the PS folds on device 0). With one
+device, threads serialize at window granularity — the interleaving the
+reference's executors had against the driver's lock; with D devices,
+windows overlap in real wall-clock, which is the multi-chip extension of
+the same semantics. Either way the center lives in HBM instead of driver
+RAM and the pull/commit hops are explicit device-to-device copies instead
+of pickled TCP.
 """
 
 from __future__ import annotations
@@ -88,12 +93,16 @@ class HostAsyncRunner:
     """
 
     def __init__(self, model, loss, tx, strategy: Strategy, window: int,
-                 metrics: Sequence[str] = (), seed: int = 0):
+                 metrics: Sequence[str] = (), seed: int = 0,
+                 devices: Optional[Sequence[jax.Device]] = None):
         self.strategy = strategy
         self.window = int(window)
         self.window_fn = make_window_fn(model, loss, tx, strategy, window,
                                         tuple(metrics), seed)
         self.tx = tx
+        # worker k runs on devices[k % D]; default = single-device mode
+        self.devices = list(devices) if devices else [jax.devices()[0]]
+        self.worker_devices: list = []  # actual placement, for tests/logs
 
     def run(self, init_params, epoch_shards: Sequence[Sequence[Sequence[dict]]]
             ) -> tuple:
@@ -103,20 +112,27 @@ class HostAsyncRunner:
         not shuffling). Workers progress through epochs without barriers —
         true asynchrony extends across epoch boundaries too."""
         num_workers = len(epoch_shards[0])
-        ps = server_for(self.strategy, init_params)
+        # center (and its folds) live on device 0; workers pull it across
+        ps = server_for(self.strategy,
+                        jax.device_put(init_params, self.devices[0]))
         histories: list[list[dict]] = [[] for _ in range(num_workers)]
         staleness: list[list[int]] = [[] for _ in range(num_workers)]
         errors: list = []
+        self.worker_devices = [self.devices[k % len(self.devices)]
+                               for k in range(num_workers)]
 
         def worker(k: int):
             try:
-                carry = self.strategy.init_carry(init_params, self.tx)
+                dev = self.worker_devices[k]
+                carry = jax.device_put(
+                    self.strategy.init_carry(init_params, self.tx), dev)
                 fold = 0
                 for shards in epoch_shards:
                     for rnd, batches in enumerate(shards[k]):
                         center, clock = ps.pull()
                         carry, commit, ms = self.window_fn(
-                            carry, center, batches,
+                            carry, jax.device_put(center, dev),
+                            jax.device_put(batches, dev),
                             np.int32(k * 1_000_003 + fold))
                         jax.block_until_ready(commit)
                         clock_at_fold = ps.commit(commit, last_update=clock)

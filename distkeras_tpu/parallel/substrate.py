@@ -205,8 +205,10 @@ def stage_epoch_chunks(shards, features_col: str, label_col: str,
     if chunk_rounds is None:
         chunk_rounds = rounds
     cols = {"features": features_col, "labels": label_col}
-    arrs = {key: [np.asarray(s[col]) for s in shards]
-            for key, col in cols.items()}
+    # columns are kept lazy here (ndarray views, memmaps, ShardedColumns);
+    # np.asarray happens per chunk slice below, so file-backed shards are
+    # read from disk in O(chunk) pieces, never materialized whole
+    arrs = {key: [s[col] for s in shards] for key, col in cols.items()}
     sharding = mesh_lib.round_major_sharded(mesh)
     for start in range(0, rounds, chunk_rounds):
         cnt = min(chunk_rounds, rounds - start)
@@ -216,7 +218,8 @@ def stage_epoch_chunks(shards, features_col: str, label_col: str,
         def stack(key):
             # round-major: (rounds, workers, window, batch, ...)
             return np.stack([
-                a[lo:hi].reshape((cnt, window, batch_size) + a.shape[1:])
+                np.asarray(a[lo:hi]).reshape(
+                    (cnt, window, batch_size) + tuple(a.shape[1:]))
                 for a in arrs[key]], axis=1)
 
         data = {key: stack(key) for key in cols}

@@ -220,11 +220,14 @@ def stage_step_chunks(dataset, features_col: str, label_col: str,
         raise ValueError(f"{n} rows cannot form one batch of {batch_size}")
     if chunk_steps is None:
         chunk_steps = steps
-    arrs = {"features": np.asarray(dataset[features_col]),
-            "labels": np.asarray(dataset[label_col])}
+    # columns stay lazy (views/memmaps/ShardedColumns); materialize per
+    # chunk so file-backed datasets stream from disk in O(chunk) pieces
+    arrs = {"features": dataset[features_col],
+            "labels": dataset[label_col]}
     for start in range(0, steps, chunk_steps):
         cnt = min(chunk_steps, steps - start)
         lo = start * batch_size
         hi = lo + cnt * batch_size
-        yield {key: a[lo:hi].reshape((cnt, batch_size) + a.shape[1:])
+        yield {key: np.asarray(a[lo:hi]).reshape(
+                   (cnt, batch_size) + tuple(a.shape[1:]))
                for key, a in arrs.items()}, cnt

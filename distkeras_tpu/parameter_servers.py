@@ -56,6 +56,15 @@ class ParameterServer:
         staleness is that value minus the clock at its pull."""
         raise NotImplementedError
 
+    def _to_center_device(self, tree: Any) -> Any:
+        """Bring a worker's delta to the center's device — the explicit
+        device-to-device hop that the reference's executor→driver TCP send
+        was (multi-device host_async workers commit from their own chips)."""
+        leaves = jax.tree.leaves(self.center_variable)
+        if not leaves or not hasattr(leaves[0], "sharding"):
+            return tree
+        return jax.device_put(tree, leaves[0].sharding)
+
     # reference lifecycle names (no socket to start/stop, kept as no-ops so
     # ported driver scripts keep working)
     def start(self) -> None:
@@ -75,6 +84,7 @@ class DeltaParameterServer(ParameterServer):
     normalization happens worker-side, see NUMERICS.md)."""
 
     def commit(self, delta: Any, last_update: int = 0) -> int:
+        delta = self._to_center_device(delta)
         with self._lock:
             at_fold = self.num_updates
             self.center_variable = _fold(self.center_variable, delta,
@@ -93,6 +103,7 @@ class DynSGDParameterServer(ParameterServer):
     minus server clock at the committer's last pull."""
 
     def commit(self, delta: Any, last_update: int = 0) -> int:
+        delta = self._to_center_device(delta)
         with self._lock:
             at_fold = self.num_updates
             staleness = at_fold - int(last_update)

@@ -170,6 +170,7 @@ class DistributedTrainer(Trainer):
                  mesh=None, seed: int = 0, mode: str = "sync",
                  checkpoint_dir: Optional[str] = None,
                  staging_rounds: Optional[int] = None,
+                 devices=None,
                  **strategy_kwargs):
         super().__init__(model, loss, worker_optimizer, learning_rate,
                          metrics, features_col, label_col, batch_size,
@@ -195,7 +196,14 @@ class DistributedTrainer(Trainer):
             # host threads oversubscribe a chip natively; the factor just
             # multiplies the thread count (reference: partitions per worker)
             self.num_workers = int(num_workers) * self.parallelism_factor
+            # worker k is pinned to devices[k % D] (default: all local
+            # devices) so wall-clock asynchrony overlaps across chips
+            self.devices = list(devices) if devices else None
         else:
+            if devices is not None:
+                raise ValueError(
+                    "devices= is a host_async knob; sync mode places "
+                    "workers via the mesh")
             self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(
                 num_workers)
             # K logical workers = factor x mesh devices; each device runs
@@ -299,6 +307,12 @@ class DistributedTrainer(Trainer):
                     chunk_rounds=self.staging_rounds)
                 if not shuffle and self.staging_rounds is None:
                     staged = chunks = list(chunks)
+                elif self.staging_rounds is not None:
+                    # background reader: disk reads + chunk stacking +
+                    # device_put dispatch overlap device compute
+                    from distkeras_tpu.data.prefetch import prefetch
+
+                    chunks = prefetch(chunks, depth=1)
             pending = []
             for data, rounds in chunks:
                 center, carries, ms = epoch_fn(center, carries, data,
@@ -347,7 +361,8 @@ class DistributedTrainer(Trainer):
             epoch_shards = [stage(dataset)] * self.num_epoch
         runner = host_async.HostAsyncRunner(
             self.model, self.loss, self.tx, self.strategy,
-            self.communication_window, self.metrics, self.seed)
+            self.communication_window, self.metrics, self.seed,
+            devices=self.devices or jax.devices())
         params, history, staleness, num_updates = runner.run(
             state.params, epoch_shards)
         self.history = history
@@ -511,6 +526,10 @@ class PjitTrainer(Trainer):
                               chunk_steps=self.staging_steps))
                 if not shuffle and self.staging_steps is None:
                     staged = chunks = list(chunks)
+                else:
+                    from distkeras_tpu.data.prefetch import prefetch
+
+                    chunks = prefetch(chunks, depth=1)
             pending = []
             for data, steps in chunks:
                 state, ms = epoch_fn(state, data, np.int32(step_offset))

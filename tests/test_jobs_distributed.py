@@ -74,3 +74,37 @@ def test_multihost_mesh_single_process():
 
 def test_initialize_noop_single_process():
     distributed.initialize()  # must not raise on one process
+
+
+def test_punchcard_save_bundle_roundtrip(tmp_path):
+    """save_bundle writes punchcard JSON + entry script + env note; a
+    Punchcard reloaded from the bundle runs the queue (VERDICT r2 ask #5)."""
+    import os
+
+    card = Punchcard(jobs=[Job(
+        "bundled-mnist", "SingleTrainer",
+        model="distkeras_tpu.models.mlp:mnist_mlp",
+        data="distkeras_tpu.data.dataset:synthetic_mnist",
+        batch_size=128, num_epoch=1)])
+    out = card.save_bundle(str(tmp_path / "bundle"))
+    names = sorted(os.listdir(out))
+    assert names == ["ENVIRONMENT.md", "punchcard.json", "run_punchcard.py"]
+
+    reloaded = Punchcard(path=os.path.join(out, "punchcard.json"))
+    # lossless spec round-trip (re-serializable: the bundle contract)
+    assert [j.to_spec() for j in reloaded.jobs] == \
+        [j.to_spec() for j in card.jobs]
+    results = reloaded.run()
+    assert len(results) == 1 and results[0]["training_time"] > 0
+    # entry script is syntactically valid python
+    compile(open(os.path.join(out, "run_punchcard.py")).read(),
+            "run_punchcard.py", "exec")
+
+
+def test_job_with_live_model_rejects_bundling():
+    import pytest
+
+    job = Job("live", "SingleTrainer", _tiny_model(), _tiny_data,
+              batch_size=64)
+    with pytest.raises(TypeError, match="dotted"):
+        job.to_spec()

@@ -8,7 +8,7 @@ import jax
 import numpy as np
 import pytest
 
-from distkeras_tpu import ADAG, DOWNPOUR, AEASGD, DynSGD
+from distkeras_tpu import ADAG, DOWNPOUR, AEASGD, DynSGD, EAMSGD
 from distkeras_tpu.data.dataset import synthetic_mnist
 from distkeras_tpu.models.mlp import MLP
 from distkeras_tpu.parallel import mesh as mesh_lib
@@ -30,6 +30,11 @@ def _mesh(n):
     (DOWNPOUR, {}),
     (DynSGD, {}),
     (AEASGD, {"rho": 1.0}),
+    # EAMSGD: the only strategy with extra per-worker state (velocity in
+    # carry.extra) through the vmapped worker path; ADAG: the
+    # window-normalized commit (advisor r2 ask)
+    (EAMSGD, {"rho": 1.0, "momentum": 0.9}),
+    (ADAG, {}),
 ])
 def test_oversubscribed_matches_fully_populated(cls, extra):
     """K=8 on a 4-device mesh (factor 2) == K=8 on an 8-device mesh."""
