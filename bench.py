@@ -81,11 +81,11 @@ def run(batch_size: int, image_side: int, window: int, rounds: int,
         # On this machine's tunneled TPU platform, block_until_ready returns
         # before execution finishes; an actual device->host fetch is the only
         # reliable completion barrier (measured: blocking-only timing reports
-        # physically impossible >100% MFU). Fetch two scalars: one depending
-        # on the metrics, one on the final center state.
-        loss = float(np.asarray(ms["loss"]).mean())
-        float(np.asarray(jax.tree.leaves(center)[0]).ravel()[0])
-        return loss
+        # physically impossible >100% MFU). ONE fetch, of the final center
+        # state — it depends on the whole program, and each fetch is a full
+        # tunnel round trip (~90ms), so fetching metrics too would bill an
+        # extra RTT to every timed call.
+        return float(np.asarray(jax.tree.leaves(center)[0]).ravel()[0])
 
     # compile + settle
     for _ in range(2):
@@ -111,12 +111,12 @@ def run(batch_size: int, image_side: int, window: int, rounds: int,
 def main():
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu:
-        # rounds=12: amortize the per-call host/tunnel dispatch overhead
-        # (~90ms measured) across 96 scanned steps per device call; uint8
-        # staging keeps the whole 12-round chunk at ~1.9 GB HBM
-        configs = [dict(batch_size=128, image_side=224, window=8, rounds=12,
+        # rounds=24: amortize the per-call host/tunnel dispatch overhead
+        # (~90ms measured) across 192 scanned steps per device call; uint8
+        # staging keeps the whole 24-round chunk at ~3.7 GB HBM
+        configs = [dict(batch_size=128, image_side=224, window=8, rounds=24,
                         num_classes=1000, tiny=False),
-                   dict(batch_size=64, image_side=224, window=8, rounds=12,
+                   dict(batch_size=64, image_side=224, window=8, rounds=24,
                         num_classes=1000, tiny=False)]
     else:
         configs = [dict(batch_size=8, image_side=32, window=2, rounds=2,

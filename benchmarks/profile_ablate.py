@@ -191,6 +191,10 @@ def main():
                                        dtype=jnp.float32))
     run_case("nf", "scan step, NF (scaled-WS, norm-free)",
              model=resnet_lib.resnet50(num_classes=CLASSES, norm="nf"))
+    run_case("nf_s2d", "scan step, NF + space-to-depth stem",
+             model=resnet_lib.resnet50(num_classes=CLASSES, norm="nf",
+                                       space_to_depth=True),
+             batch_dtype=jnp.uint8)
     run_case("nf_u8", "scan step, NF + uint8 input",
              model=resnet_lib.resnet50(num_classes=CLASSES, norm="nf"),
              batch_dtype=jnp.uint8)

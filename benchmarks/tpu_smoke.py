@@ -1,0 +1,73 @@
+"""Real-TPU smoke: every trainer strategy runs one small training job on
+actual hardware (SURVEY §4: "one real-TPU smoke per strategy").
+
+The pytest suite forces the virtual CPU mesh (tests/conftest.py), so this
+script is the hardware-facing complement: run it on a machine with a TPU
+attached; it prints one line per trainer and exits nonzero on any failure
+or non-finite loss.
+
+Run: python benchmarks/tpu_smoke.py
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+
+    from distkeras_tpu import (ADAG, AEASGD, AveragingTrainer, DOWNPOUR,
+                               DynSGD, EAMSGD, EnsembleTrainer, PjitTrainer,
+                               SingleTrainer, synthetic_mnist)
+    from distkeras_tpu.models import MLP
+
+    dev = jax.devices()[0]
+    print(f"# device: {dev.device_kind} ({dev.platform})")
+    ds = synthetic_mnist(n=2048)
+    failures = 0
+
+    def run(name, trainer, **train_kw):
+        nonlocal failures
+        import time
+
+        t0 = time.perf_counter()
+        try:
+            trainer.train(ds, **train_kw)
+            h = trainer.get_history()
+            ok = h and np.isfinite([x["loss"] for x in h]).all()
+            status = "OK " if ok else "NONFINITE"
+            failures += 0 if ok else 1
+            print(f"{name:12s} {status} loss {h[0]['loss']:.3f} -> "
+                  f"{h[-1]['loss']:.3f}  ({len(h)} steps, "
+                  f"{time.perf_counter() - t0:.1f}s)")
+        except Exception as e:
+            failures += 1
+            print(f"{name:12s} FAIL {type(e).__name__}: {e}")
+
+    model = lambda: MLP(features=(128,))  # noqa: E731
+    common = dict(worker_optimizer="sgd", learning_rate=0.05,
+                  batch_size=64, num_epoch=2, metrics=())
+    async_kw = dict(common, num_workers=1, communication_window=4)
+
+    run("single", SingleTrainer(model(), **common), shuffle=True)
+    run("averaging", AveragingTrainer(model(), **async_kw))
+    run("ensemble", EnsembleTrainer(model(), **async_kw))
+    run("downpour", DOWNPOUR(model(), **async_kw), shuffle=True)
+    run("adag", ADAG(model(), **async_kw), shuffle=True)
+    run("dynsgd", DynSGD(model(), **async_kw), shuffle=True)
+    run("aeasgd", AEASGD(model(), rho=1.0, **async_kw), shuffle=True)
+    run("eamsgd", EAMSGD(model(), rho=1.0, momentum=0.9, **async_kw),
+        shuffle=True)
+    run("pjit", PjitTrainer(model(), **common), shuffle=True)
+    run("host_async", DOWNPOUR(model(), mode="host_async", **async_kw),
+        shuffle=True)
+
+    print(f"# {failures} failures")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -154,7 +154,10 @@ class Dataset:
         n = len(self)
         limit = (n // batch_size) * batch_size if drop_remainder else n
         for start in range(0, limit, batch_size):
-            yield {c: self._columns[c][start:start + batch_size] for c in cols}
+            # np.asarray materializes lazy columns (ShardedColumn/memmap)
+            # batch by batch — consumers hand these straight to jit
+            yield {c: np.asarray(self._columns[c][start:start + batch_size])
+                   for c in cols}
 
     def num_batches(self, batch_size: int, drop_remainder: bool = True) -> int:
         n = len(self)

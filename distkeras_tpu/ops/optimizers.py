@@ -38,6 +38,8 @@ def get(optimizer: Union[str, optax.GradientTransformation],
         return optax.rmsprop(learning_rate)
     if name == "adadelta":
         return optax.adadelta(learning_rate)
+    if name == "nadam":
+        return optax.nadam(learning_rate)
     if name == "lamb":
         return optax.lamb(learning_rate)
     raise ValueError(f"Unknown optimizer {optimizer!r}")

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distkeras_tpu import engine
+from distkeras_tpu.utils.fetch import device_get_batched
 from distkeras_tpu.parameter_servers import (
     DeltaParameterServer,
     DynSGDParameterServer,
@@ -137,7 +138,7 @@ class HostAsyncRunner:
                         jax.block_until_ready(commit)
                         clock_at_fold = ps.commit(commit, last_update=clock)
                         staleness[k].append(clock_at_fold - clock)
-                        ms = jax.device_get(ms)
+                        ms = device_get_batched(ms)
                         n = len(ms["loss"])
                         histories[k].extend(
                             {key: float(v[i]) for key, v in ms.items()}
@@ -157,7 +158,7 @@ class HostAsyncRunner:
         center, _ = ps.pull()
         history = [h for hs in histories for h in hs]
         stal = [float(s) for ss in staleness for s in ss]
-        return jax.device_get(center), history, stal, ps.num_updates
+        return device_get_batched(center), history, stal, ps.num_updates
 
 
 def stage_worker_shards(shards, features_col: str, label_col: str,

@@ -26,7 +26,7 @@ global batch — asserted by tests.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Callable, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -236,6 +236,169 @@ class PipelinedLM:
                     params["blocks"]),
             }
             return jax.device_put(params, shardings)
+
+        def place_batch(batch):
+            return jax.device_put(batch, NamedSharding(mesh, P()))
+
+        return step_fn, place_params, place_batch
+
+
+class GenericPipeline:
+    """GPipe over ARBITRARY stage modules — the stage-partitioning API.
+
+    ``stages`` is any sequence of flax modules applied in order
+    (``stages[k](x)``); they may be completely heterogeneous — different
+    classes, widths, even activation SHAPES between stages. Stage k runs on
+    mesh device k; activations hop stage-to-stage via ``ppermute`` through
+    a single flat buffer padded to the largest inter-stage activation
+    (static per-branch reshapes keep XLA happy); per-device stage dispatch
+    is one ``lax.switch``. Backward is AD through the schedule, exactly as
+    in :class:`PipelinedLM`.
+
+    Trade-off vs the stacked homogeneous path (PipelinedLM): every stage's
+    params are REPLICATED across the mesh (an SPMD program cannot place a
+    pytree on only one device), so this buys arbitrary-model capability and
+    compute/bubble behavior, not per-stage parameter memory scaling. Use
+    the stacked layout when stages are homogeneous and params dominate.
+
+    Loss: ``loss`` is a Keras-style name or callable ``(logits, labels) ->
+    scalar`` applied to the LAST stage's output per microbatch.
+    """
+
+    def __init__(self, stages: Sequence[nn.Module], num_microbatches: int,
+                 loss="categorical_crossentropy", dtype=jnp.float32):
+        from distkeras_tpu.ops import losses as losses_lib
+
+        if len(stages) < 2:
+            raise ValueError("a pipeline needs >= 2 stages")
+        self.stages = list(stages)
+        self.num_stages = len(stages)
+        self.M = int(num_microbatches)
+        self.dtype = dtype
+        self.loss_fn = losses_lib.get(loss) if isinstance(loss, str) else loss
+        self._shapes: Optional[list] = None  # per-stage output shapes
+
+    # -- params ------------------------------------------------------------
+    def init(self, rng, sample_features) -> tuple:
+        """Tuple of per-stage param trees; also records the static
+        activation shapes for one microbatch of this shape."""
+        keys = jax.random.split(rng, self.num_stages)
+        params = []
+        shapes = []
+        x = jnp.asarray(sample_features, self.dtype)
+        for k, (stage, key) in enumerate(zip(self.stages, keys)):
+            p = stage.init(key, x)["params"]
+            x = stage.apply({"params": p}, x)
+            shapes.append(tuple(x.shape))
+            params.append(p)
+        self._shapes = shapes
+        return tuple(params)
+
+    def reference_apply(self, params, features):
+        """Single-device sequential forward with the same params (oracle)."""
+        x = jnp.asarray(features, self.dtype)
+        for stage, p in zip(self.stages, params):
+            x = stage.apply({"params": p}, x)
+        return x
+
+    # -- pipelined train step ----------------------------------------------
+    def build_train_step(self, tx: optax.GradientTransformation, mesh: Mesh):
+        """(step_fn, place_params, place_batch); batch =
+        {"features": [B, ...], "labels": [B, ...]} with B divisible by
+        num_microbatches. step_fn(params, opt_state, batch) ->
+        (params, opt_state, {"loss"}).
+        """
+        if self._shapes is None:
+            raise RuntimeError("call init() before build_train_step()")
+        stages_n = self.num_stages
+        M = self.M
+        if mesh.shape[STAGE_AXIS] != stages_n:
+            raise ValueError(
+                f"mesh has {mesh.shape[STAGE_AXIS]} stage devices, "
+                f"pipeline has {stages_n} stages")
+        # hop buffer: outputs of stages 0..P-2 travel; pad to the largest
+        hop_sizes = [int(np.prod(s)) for s in self._shapes[:-1]]
+        buf_n = max(hop_sizes)
+        shapes = self._shapes
+
+        def pp_loss(params, feats_mb, labels_mb):
+            stage = jax.lax.axis_index(STAGE_AXIS)
+
+            def branch(k):
+                def run(buf, feat_in, label):
+                    if k == 0:
+                        x = feat_in.astype(self.dtype)
+                    else:
+                        n_in = hop_sizes[k - 1]
+                        x = buf[:n_in].reshape(shapes[k - 1])
+                    out = self.stages[k].apply({"params": params[k]}, x)
+                    if k == stages_n - 1:
+                        l = self.loss_fn(out.astype(jnp.float32), label)
+                        flat = jnp.zeros((buf_n,), self.dtype)
+                    else:
+                        l = jnp.float32(0)
+                        flat = jnp.pad(
+                            out.reshape(-1).astype(self.dtype),
+                            (0, buf_n - hop_sizes[k]))
+                    return flat, l
+                return run
+
+            branches = [branch(k) for k in range(stages_n)]
+
+            def tick(carry, tick_i):
+                buf, loss_sum, loss_cnt = carry
+                in_idx = jnp.clip(tick_i, 0, M - 1)
+                out_idx = jnp.clip(tick_i - (stages_n - 1), 0, M - 1)
+                flat, l = jax.lax.switch(
+                    stage, branches, buf, feats_mb[in_idx],
+                    labels_mb[out_idx])
+                # the tail stage only produces real losses once the first
+                # microbatch has traversed the pipe
+                live = jnp.logical_and(stage == stages_n - 1,
+                                       tick_i >= stages_n - 1)
+                loss_sum = loss_sum + jnp.where(live, l, 0.0)
+                loss_cnt = loss_cnt + jnp.where(live, 1.0, 0.0)
+                perm = [(i, i + 1) for i in range(stages_n - 1)]
+                buf = jax.lax.ppermute(flat, STAGE_AXIS, perm)
+                return (buf, loss_sum, loss_cnt), None
+
+            init = (jnp.zeros((buf_n,), self.dtype), jnp.float32(0),
+                    jnp.float32(0))
+            (_, loss_sum, loss_cnt), _ = jax.lax.scan(
+                tick, init, jnp.arange(M + stages_n - 1, dtype=jnp.int32))
+            loss_sum = jax.lax.psum(loss_sum, STAGE_AXIS)
+            loss_cnt = jnp.maximum(jax.lax.psum(loss_cnt, STAGE_AXIS), 1.0)
+            return loss_sum / loss_cnt
+
+        def loss_shmapped(params, feats_mb, labels_mb):
+            fn = jax.shard_map(
+                pp_loss, mesh=mesh,
+                in_specs=(tuple(jax.tree.map(lambda _: P(), p)
+                                for p in params), P(), P()),
+                out_specs=P(),
+                check_vma=False)
+            return fn(params, feats_mb, labels_mb)
+
+        def step(params, opt_state, batch):
+            feats, labels = batch["features"], batch["labels"]
+            b = feats.shape[0]
+            if b % M != 0:
+                raise ValueError(f"batch {b} not divisible by "
+                                 f"microbatches {M}")
+            feats_mb = feats.reshape((M, b // M) + feats.shape[1:])
+            labels_mb = labels.reshape((M, b // M) + labels.shape[1:])
+            loss, grads = jax.value_and_grad(loss_shmapped)(
+                params, feats_mb, labels_mb)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {"loss": loss}
+
+        step_fn = jax.jit(step, donate_argnums=(0, 1))
+
+        def place_params(params):
+            return jax.device_put(
+                params, jax.tree.map(lambda _: NamedSharding(mesh, P()),
+                                     params))
 
         def place_batch(batch):
             return jax.device_put(batch, NamedSharding(mesh, P()))

@@ -134,3 +134,33 @@ def test_prefetch_abandonment_releases_producer():
     n = len(produced)
     time.sleep(0.3)
     assert len(produced) == n  # producer has stopped
+
+
+def test_single_trainer_on_multishard_file_dataset(shard_files):
+    """batches() must materialize lazy columns: SingleTrainer (which feeds
+    batches straight into jit) trains on a multi-shard file-backed dataset
+    whose shard boundaries do not align with batch boundaries."""
+    from distkeras_tpu import SingleTrainer
+    from distkeras_tpu.models import MLP
+
+    ds, paths = shard_files  # cuts at 200/320, batch 64: misaligned
+    fds = Dataset.from_files(paths)
+    t = SingleTrainer(MLP(features=(16,)), worker_optimizer="sgd",
+                      learning_rate=0.1, batch_size=64, num_epoch=1,
+                      metrics=())
+    t.train(fds)
+    losses = [h["loss"] for h in t.history]
+    assert len(losses) == 8 and np.isfinite(losses).all()
+
+
+def test_device_get_batched_chunks_many_leaves():
+    """> _MAX_CONCAT_ARGS leaves fetch correctly via chunked concats."""
+    import jax
+    import jax.numpy as jnp
+
+    from distkeras_tpu.utils import fetch
+
+    tree = [jnp.full((2,), float(i)) for i in range(fetch._MAX_CONCAT_ARGS + 7)]
+    host = fetch.device_get_batched(tree)
+    for i, h in enumerate(host):
+        np.testing.assert_array_equal(h, np.full((2,), float(i)))

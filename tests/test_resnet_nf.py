@@ -103,3 +103,27 @@ def test_nf_resnet_trains():
         losses.append(float(ms["loss"]))
     assert losses[-1] < 0.5 * losses[0], losses[::10]
     assert np.isfinite(losses).all()
+
+
+def test_space_to_depth_stem_shapes_and_grads():
+    """space_to_depth=True (MXU-friendly stem rearrange) preserves output
+    shape and trains, for both norm variants."""
+    for norm in ("nf", "gn"):
+        model = ResNet(stage_sizes=(1, 1), block=BasicBlock, width=8,
+                       num_classes=5, dtype=jnp.float32, norm=norm,
+                       space_to_depth=True)
+        x = jnp.asarray(
+            np.random.default_rng(8).standard_normal((2, 32, 32, 3)),
+            jnp.float32)
+        params = model.init(jax.random.key(0), x, train=False)["params"]
+        y = model.apply({"params": params}, x, train=False)
+        assert y.shape == (2, 5)
+        assert params["conv_stem"]["kernel"].shape[:3] == (4, 4, 12)
+
+        def loss(p):
+            return jnp.mean(
+                model.apply({"params": p}, x, train=True) ** 2)
+
+        grads = jax.grad(loss)(params)
+        assert all(np.isfinite(np.asarray(g)).all()
+                   for g in jax.tree.leaves(grads))
