@@ -37,7 +37,12 @@ def main() -> int:
         try:
             trainer.train(ds, **train_kw)
             h = trainer.get_history()
-            ok = h and np.isfinite([x["loss"] for x in h]).all()
+            if not h:
+                failures += 1
+                print(f"{name:12s} EMPTY-HISTORY "
+                      f"({time.perf_counter() - t0:.1f}s)")
+                return
+            ok = np.isfinite([x["loss"] for x in h]).all()
             status = "OK " if ok else "NONFINITE"
             failures += 0 if ok else 1
             print(f"{name:12s} {status} loss {h[0]['loss']:.3f} -> "

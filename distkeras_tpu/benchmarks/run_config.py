@@ -32,13 +32,6 @@ import jax
 import numpy as np
 
 
-def _sync(tree):
-    # device->host fetch: the only reliable completion barrier on tunneled
-    # backends (see bench.py)
-    for leaf in jax.tree.leaves(tree)[:1]:
-        float(np.asarray(leaf).ravel()[0])
-
-
 def _flops_per_step(trainer, ds):
     """Analytic matmul/conv FLOPs of ONE worker's train step (fwd+bwd+opt),
     traced — no device execution. None when tracing fails (exotic loss)."""
@@ -85,10 +78,14 @@ def _time_trainer(trainer, ds):
     from distkeras_tpu.trainers import PjitTrainer
 
     # PjitTrainer's batch_size is the GLOBAL batch (sharded over workers)
-    # and its history is per global step; the async zoo's batch_size is
-    # per-worker with worker-averaged per-step history
-    workers = 1 if isinstance(trainer, PjitTrainer) \
-        else getattr(trainer, "num_workers", 1)
+    # and its history is per global step; host_async history is per-worker
+    # FLATTENED (already counts every worker's steps); the sync async
+    # zoo's batch_size is per-worker with worker-averaged per-step history
+    if isinstance(trainer, PjitTrainer) or \
+            getattr(trainer, "mode", "sync") == "host_async":
+        workers = 1
+    else:
+        workers = getattr(trainer, "num_workers", 1)
     samples = n_steps * trainer.batch_size * workers
     out = {"samples_per_sec": round(samples / dt, 2),
            "steps": n_steps, "wall_s": round(dt, 2),

@@ -565,10 +565,23 @@ class PjitTrainer(Trainer):
 
 class SingleTrainer(Trainer):
     """One replica, plain minibatch SGD — the reference's minimum slice
-    (SingleTrainer: coalesce to one partition, train locally)."""
+    (SingleTrainer: coalesce to one partition, train locally).
+
+    ``staging_steps=None`` (default) stages the whole epoch device-resident
+    once and reuses it every epoch; an int bounds device data memory to
+    O(staging_steps) chunks streamed with background prefetch — use it when
+    the dataset doesn't fit in HBM.
+    """
+
+    def __init__(self, *args, staging_steps: Optional[int] = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.staging_steps = staging_steps
 
     def train(self, dataset: Dataset, shuffle: bool = False,
               resume: bool = False):
+        from distkeras_tpu.data.prefetch import prefetch
+        from distkeras_tpu.parallel import tensor
+
         self._start()
         if shuffle:
             dataset = dataset.shuffle(self.seed)
@@ -577,24 +590,42 @@ class SingleTrainer(Trainer):
         ckpt = self._checkpointer()
         snap, start_epoch = self._maybe_resume(ckpt, {"state": state}, resume)
         state = snap["state"]
-        if getattr(self, "_step_fn", None) is None:
-            self._step_fn = engine.make_train_step(
+        # whole staged chunks scanned in ONE device call each — numerics
+        # identical to the old per-batch step loop (same rng-fold of
+        # state.step), but without a host dispatch per minibatch
+        if getattr(self, "_epoch_fn", None) is None:
+            self._epoch_fn = engine.make_epoch_fn(
                 self.model, self.loss, self.tx, metrics=self.metrics,
                 dropout_seed=self.seed)
-        step_fn = self._step_fn
-        device_history = []  # device arrays; fetched once at the end so the
-        for epoch in range(start_epoch, self.num_epoch):  # hot loop stays on device
-            for raw in dataset.batches(self.batch_size,
-                                       cols=[self.features_col, self.label_col]):
-                state, m = step_fn(state, self._batch_dict(raw))
-                device_history.append(m)
+        epoch_fn = self._epoch_fn
+        staged = None
+        device_history = []  # device arrays; fetched once at the end
+        for epoch in range(start_epoch, self.num_epoch):
+            if staged is not None:
+                chunks = staged
+            else:
+                chunks = (jax.device_put(
+                    {"features": data["features"], "labels": data["labels"]})
+                    for data, _ in tensor.stage_step_chunks(
+                        dataset, self.features_col, self.label_col,
+                        self.batch_size, chunk_steps=self.staging_steps))
+                if self.staging_steps is None:
+                    staged = chunks = list(chunks)
+                else:
+                    chunks = prefetch(chunks, depth=1)
+            for data in chunks:
+                state, ms = epoch_fn(state, data)
+                device_history.append(ms)
             if ckpt is not None:
                 ckpt.save(epoch, {"state": state})
         if ckpt is not None:
             ckpt.wait()
             ckpt.close()
-        self.history = [{k: float(v) for k, v in h.items()}
-                        for h in device_get_batched(device_history)]
+        self.history = []
+        for ms in device_get_batched(device_history):
+            steps = len(next(iter(ms.values())))
+            self.history.extend({k: float(v[i]) for k, v in ms.items()}
+                                for i in range(steps))
         self.params = device_get_batched(state.params)
         self._stop()
         return self.params

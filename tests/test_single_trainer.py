@@ -39,3 +39,30 @@ def test_dropout_and_accuracy_metric():
     assert "accuracy" in hist[0]
     assert hist[-1]["accuracy"] > hist[0]["accuracy"]
     assert 0.0 <= hist[0]["accuracy"] <= 1.0
+
+
+def test_single_trainer_staging_steps_chunked_equals_resident():
+    """staging_steps (O(chunk) device memory + prefetch) gives the same
+    trajectory as whole-epoch residency."""
+    import numpy as np
+
+    from distkeras_tpu import SingleTrainer
+    from distkeras_tpu.data.dataset import synthetic_mnist
+    from distkeras_tpu.models.mlp import MLP
+
+    ds = synthetic_mnist(n=512)
+
+    def run(staging_steps):
+        t = SingleTrainer(MLP(features=(16,)), worker_optimizer="sgd",
+                          learning_rate=0.1, batch_size=32, num_epoch=2,
+                          metrics=(), staging_steps=staging_steps)
+        t.train(ds)
+        return [h["loss"] for h in t.history], t.params
+
+    losses_res, params_res = run(None)
+    losses_chk, params_chk = run(3)  # ragged chunks: 3+3+3+3+3+1 steps
+    assert losses_res == losses_chk
+    import jax
+
+    for a, b in zip(jax.tree.leaves(params_res), jax.tree.leaves(params_chk)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
