@@ -60,6 +60,9 @@ def _num_chips(trainer) -> int:
     mesh = getattr(trainer, "mesh", None)
     if mesh is not None:
         return int(np.prod(list(mesh.shape.values())))
+    if getattr(trainer, "mode", "sync") == "host_async":
+        # worker threads pin across devices[k % D] (all local by default)
+        return len(getattr(trainer, "devices", None) or jax.devices())
     return 1
 
 

@@ -109,25 +109,36 @@ def make_train_step(model, loss, tx: optax.GradientTransformation,
     jitted with donated state. A per-step dropout rng is derived by folding
     the step counter into ``dropout_seed``, so stochastic layers just work.
     """
+    one_step = _make_step_body(model, loss, tx, with_metrics, metrics,
+                               dropout_seed)
+    return jax.jit(one_step, donate_argnums=(0,))
+
+
+def _make_step_body(model, loss, tx: optax.GradientTransformation,
+                    with_grad_norm: bool, metrics: tuple,
+                    dropout_seed: int) -> Callable:
+    """The ONE unjitted step body shared by :func:`make_train_step` and
+    :func:`make_epoch_fn` — keeping them numerically identical by
+    construction, not by hand-synced copies."""
     compute_loss = make_loss_fn(model, loss)
     base_key = jax.random.key(dropout_seed)
+    metric_names = tuple(metrics)
 
-    def step(state: TrainState, batch: Batch) -> tuple[TrainState, dict]:
+    def one_step(state: TrainState, batch: Batch):
         rngs = {"dropout": jax.random.fold_in(base_key, state.step)}
         (loss_val, logits), grads = jax.value_and_grad(
             compute_loss, has_aux=True)(state.params, batch, rngs)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        new_state = TrainState(step=state.step + 1, params=params,
-                               opt_state=opt_state)
         out = {"loss": loss_val}
-        if with_metrics:
+        if with_grad_norm:
             out["grad_norm"] = global_norm(grads)
-        for name in metrics:
+        for name in metric_names:
             out[name] = compute_metric(name, logits, batch["labels"])
-        return new_state, out
+        return TrainState(step=state.step + 1, params=params,
+                          opt_state=opt_state), out
 
-    return jax.jit(step, donate_argnums=(0,))
+    return one_step
 
 
 def make_epoch_fn(model, loss, tx: optax.GradientTransformation,
@@ -137,28 +148,14 @@ def make_epoch_fn(model, loss, tx: optax.GradientTransformation,
 
     ``epoch(state, data) -> (state, metrics)`` where ``data`` leaves are
     [steps, batch, ...] and metrics values are [steps] arrays. Numerics are
-    identical to looping :func:`make_train_step` over the same batches —
-    the per-step dropout rng folds the same ``state.step`` counter — but a
+    identical to looping :func:`make_train_step` over the same batches by
+    construction — both scan/loop the same :func:`_make_step_body` — but a
     whole epoch costs one dispatch instead of one per step (which on
     tunneled backends is ~100x the difference).
     """
-    compute_loss = make_loss_fn(model, loss)
-    base_key = jax.random.key(dropout_seed)
-    metric_names = tuple(metrics)
+    one_step = _make_step_body(model, loss, tx, True, metrics, dropout_seed)
 
     def epoch(state: TrainState, data: Batch):
-        def one_step(st, batch):
-            rngs = {"dropout": jax.random.fold_in(base_key, st.step)}
-            (loss_val, logits), grads = jax.value_and_grad(
-                compute_loss, has_aux=True)(st.params, batch, rngs)
-            updates, opt_state = tx.update(grads, st.opt_state, st.params)
-            params = optax.apply_updates(st.params, updates)
-            out = {"loss": loss_val, "grad_norm": global_norm(grads)}
-            for name in metric_names:
-                out[name] = compute_metric(name, logits, batch["labels"])
-            return TrainState(step=st.step + 1, params=params,
-                              opt_state=opt_state), out
-
         return jax.lax.scan(one_step, state, data)
 
     return jax.jit(epoch, donate_argnums=(0,))

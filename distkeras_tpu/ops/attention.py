@@ -27,6 +27,27 @@ import jax.numpy as jnp
 MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
+def flash_attention_causal(q: jax.Array, k: jax.Array, v: jax.Array
+                           ) -> jax.Array:
+    """Fused causal attention via the in-library pallas TPU kernel.
+
+    [batch, seq, heads, head_dim] in/out (transposed to the kernel's BHTD
+    internally). O(seq) memory instead of materializing the [seq, seq]
+    score matrix — the single-chip long-context path, complementing ring
+    attention's cross-chip sequence parallelism. Constraints inherited
+    from the kernel: seq a multiple of its block size (powers of two >=
+    128 are safe); falls back to the XLA path off-TPU.
+    """
+    if jax.devices()[0].platform != "tpu":
+        return dot_product_attention(q, k, v, causal=True)
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+    scale = q.shape[-1] ** -0.5
+    qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))  # -> [b, h, t, d]
+    out = fa.flash_attention(qt, kt, vt, causal=True, sm_scale=scale)
+    return out.swapaxes(1, 2).astype(q.dtype)
+
+
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           mask: Optional[jax.Array] = None,
                           causal: bool = False) -> jax.Array:

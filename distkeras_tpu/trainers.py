@@ -140,6 +140,44 @@ class Trainer:
                 f"{effective_batch}; no full batch can be formed "
                 f"(static-shape batching drops the ragged tail)")
 
+    #: whole-epoch-resident staging above this estimate warns to use the
+    #: chunked knob (staging_rounds / staging_steps) instead of OOMing
+    _RESIDENT_WARN_BYTES = 4 << 30
+
+    def _warn_if_large_resident(self, dataset: Dataset, knob: str):
+        try:
+            total = sum(
+                np.dtype(dataset[c].dtype).itemsize *
+                int(np.prod(dataset[c].shape))
+                for c in (self.features_col, self.label_col))
+        except Exception:
+            return
+        if total > self._RESIDENT_WARN_BYTES:
+            import warnings
+
+            warnings.warn(
+                f"Staging the whole epoch device-resident "
+                f"(~{total / 2**30:.1f} GiB). Pass {knob}= to bound device "
+                f"data memory to O(chunk) with background prefetch.",
+                ResourceWarning, stacklevel=3)
+
+    @staticmethod
+    def _epoch_chunk_stream(staged, make_gen, resident: bool):
+        """The shared staged/cache/prefetch pattern of every trainer's
+        epoch loop: returns ``(chunks, staged)``. ``resident=True``
+        materializes the generator once and reuses it every epoch;
+        otherwise chunks stream through a depth-1 background prefetch
+        (double buffering)."""
+        if staged is not None:
+            return staged, staged
+        gen = make_gen()
+        if resident:
+            staged = list(gen)
+            return staged, staged
+        from distkeras_tpu.data.prefetch import prefetch
+
+        return prefetch(gen, depth=1), None
+
     def train(self, dataset: Dataset, shuffle: bool = False):
         raise NotImplementedError
 
@@ -274,6 +312,8 @@ class DistributedTrainer(Trainer):
         self._start()
         self._check_trainable(
             dataset, self.batch_size * self.communication_window * self.num_workers)
+        if self.staging_rounds is None:
+            self._warn_if_large_resident(dataset, "staging_rounds")
         center, carries = self._setup_state(dataset)
         ckpt = self._checkpointer()
         snap, start_epoch = self._maybe_resume(
@@ -303,23 +343,15 @@ class DistributedTrainer(Trainer):
             # chunk i+1 is pulled, so host slicing + device_put overlap
             # compute; metric fetches are deferred to the epoch end so they
             # don't serialize the chunks.
-            if staged is not None:
-                chunks = staged
-            else:
-                ds = dataset.shuffle(self.seed + epoch) if shuffle else dataset
-                chunks = substrate.stage_epoch_chunks(
-                    ds.repartition(self.num_workers), self.features_col,
-                    self.label_col, self.batch_size,
+            chunks, staged = self._epoch_chunk_stream(
+                staged,
+                lambda: substrate.stage_epoch_chunks(
+                    (dataset.shuffle(self.seed + epoch)
+                     if shuffle else dataset).repartition(self.num_workers),
+                    self.features_col, self.label_col, self.batch_size,
                     self.communication_window, self.mesh,
-                    chunk_rounds=self.staging_rounds)
-                if not shuffle and self.staging_rounds is None:
-                    staged = chunks = list(chunks)
-                elif self.staging_rounds is not None:
-                    # background reader: disk reads + chunk stacking +
-                    # device_put dispatch overlap device compute
-                    from distkeras_tpu.data.prefetch import prefetch
-
-                    chunks = prefetch(chunks, depth=1)
+                    chunk_rounds=self.staging_rounds),
+                resident=not shuffle and self.staging_rounds is None)
             pending = []
             for data, rounds in chunks:
                 center, carries, ms = epoch_fn(center, carries, data,
@@ -507,6 +539,8 @@ class PjitTrainer(Trainer):
 
         self._start()
         self._check_trainable(dataset, self.batch_size)
+        if self.staging_steps is None:
+            self._warn_if_large_resident(dataset, "staging_steps")
         state = self._init_params(dataset)
         if getattr(self, "_pjit_fns", None) is None:
             self._pjit_fns = tensor.build_pjit_epoch_fn(
@@ -526,21 +560,16 @@ class PjitTrainer(Trainer):
             # Same single code path as DistributedTrainer.train: the
             # staging_steps=None default is the one-chunk case, cached
             # across epochs when not shuffling.
-            if staged is not None:
-                chunks = staged
-            else:
-                ds = dataset.shuffle(self.seed + epoch) if shuffle else dataset
-                chunks = ((place_data(data), steps)
-                          for data, steps in tensor.stage_step_chunks(
-                              ds, self.features_col, self.label_col,
-                              self.batch_size,
-                              chunk_steps=self.staging_steps))
-                if not shuffle and self.staging_steps is None:
-                    staged = chunks = list(chunks)
-                else:
-                    from distkeras_tpu.data.prefetch import prefetch
-
-                    chunks = prefetch(chunks, depth=1)
+            chunks, staged = self._epoch_chunk_stream(
+                staged,
+                lambda: ((place_data(data), steps)
+                         for data, steps in tensor.stage_step_chunks(
+                             dataset.shuffle(self.seed + epoch)
+                             if shuffle else dataset,
+                             self.features_col, self.label_col,
+                             self.batch_size,
+                             chunk_steps=self.staging_steps)),
+                resident=not shuffle and self.staging_steps is None)
             pending = []
             for data, steps in chunks:
                 state, ms = epoch_fn(state, data, np.int32(step_offset))
@@ -579,13 +608,14 @@ class SingleTrainer(Trainer):
 
     def train(self, dataset: Dataset, shuffle: bool = False,
               resume: bool = False):
-        from distkeras_tpu.data.prefetch import prefetch
         from distkeras_tpu.parallel import tensor
 
         self._start()
         if shuffle:
             dataset = dataset.shuffle(self.seed)
         self._check_trainable(dataset, self.batch_size)
+        if self.staging_steps is None:
+            self._warn_if_large_resident(dataset, "staging_steps")
         state = self._init_params(dataset)
         ckpt = self._checkpointer()
         snap, start_epoch = self._maybe_resume(ckpt, {"state": state}, resume)
@@ -601,18 +631,14 @@ class SingleTrainer(Trainer):
         staged = None
         device_history = []  # device arrays; fetched once at the end
         for epoch in range(start_epoch, self.num_epoch):
-            if staged is not None:
-                chunks = staged
-            else:
-                chunks = (jax.device_put(
+            chunks, staged = self._epoch_chunk_stream(
+                staged,
+                lambda: (jax.device_put(
                     {"features": data["features"], "labels": data["labels"]})
                     for data, _ in tensor.stage_step_chunks(
                         dataset, self.features_col, self.label_col,
-                        self.batch_size, chunk_steps=self.staging_steps))
-                if self.staging_steps is None:
-                    staged = chunks = list(chunks)
-                else:
-                    chunks = prefetch(chunks, depth=1)
+                        self.batch_size, chunk_steps=self.staging_steps)),
+                resident=self.staging_steps is None)
             for data in chunks:
                 state, ms = epoch_fn(state, data)
                 device_history.append(ms)

@@ -130,9 +130,16 @@ def test_prefetch_abandonment_releases_producer():
     it = prefetch(gen(), depth=1)
     assert next(it) == 0
     it.close()  # abandon mid-stream
-    time.sleep(0.4)  # > the producer's 0.1s put timeout
-    n = len(produced)
-    time.sleep(0.3)
+    # poll until production stabilizes (scheduler-load tolerant), then
+    # confirm it stays stopped
+    deadline = time.monotonic() + 5.0
+    n = -1
+    while time.monotonic() < deadline:
+        cur = len(produced)
+        if cur == n:
+            break
+        n = cur
+        time.sleep(0.3)  # > the producer's 0.1s put timeout
     assert len(produced) == n  # producer has stopped
 
 

@@ -68,3 +68,22 @@ def test_ring_grads_finite(seq_mesh):
     grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     for g in grads:
         assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_flash_attention_option_cpu_fallback():
+    """attention="flash" plumbs through the GPT family; off-TPU it falls
+    back to the XLA path, so outputs match attention="full" exactly."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distkeras_tpu.models.gpt import gpt_tiny
+
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 256, (2, 16)),
+                      jnp.int32)
+    full = gpt_tiny(attention="full")
+    flash = gpt_tiny(attention="flash")
+    params = full.init(jax.random.key(0), ids)["params"]
+    y_full = full.apply({"params": params}, ids)
+    y_flash = flash.apply({"params": params}, ids)
+    np.testing.assert_array_equal(np.asarray(y_full), np.asarray(y_flash))
