@@ -61,8 +61,10 @@ def _num_chips(trainer) -> int:
     if mesh is not None:
         return int(np.prod(list(mesh.shape.values())))
     if getattr(trainer, "mode", "sync") == "host_async":
-        # worker threads pin across devices[k % D] (all local by default)
-        return len(getattr(trainer, "devices", None) or jax.devices())
+        # worker threads pin across devices[k % D] (all local by default);
+        # fewer workers than devices leaves the surplus chips idle
+        n_dev = len(getattr(trainer, "devices", None) or jax.devices())
+        return min(getattr(trainer, "num_workers", n_dev), n_dev)
     return 1
 
 

@@ -60,10 +60,34 @@ def round_major_sharded(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(None, WORKER_AXIS))
 
 
+def put_global(tree, sharding):
+    """Place host data onto a (possibly multi-process) mesh.
+
+    ``sharding`` is one ``NamedSharding`` for every leaf, or a pytree of
+    shardings matching ``tree``. Single process: plain ``device_put``.
+    Multi-process (a mesh spanning the coordination service's global
+    devices): every process must hold the SAME full host array —
+    deterministic init / identical datasets, the contract the reference
+    met by broadcasting from the Spark driver — and each materializes only
+    the shards addressable to it via ``make_array_from_callback``.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(tree, sharding)
+
+    def put(x, sh):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(
+            x.shape, sh, lambda idx: x[idx])
+
+    if isinstance(sharding, NamedSharding):
+        return jax.tree.map(lambda x: put(x, sharding), tree)
+    return jax.tree.map(put, tree, sharding)
+
+
 def put_replicated(tree, mesh: Mesh):
-    return jax.device_put(tree, replicated(mesh))
+    return put_global(tree, replicated(mesh))
 
 
 def put_worker_sharded(tree, mesh: Mesh):
     """Place a pytree whose leaves all have a leading ``workers`` axis."""
-    return jax.device_put(tree, worker_sharded(mesh))
+    return put_global(tree, worker_sharded(mesh))

@@ -36,6 +36,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distkeras_tpu.models.gpt import DecoderBlock
+from distkeras_tpu.parallel import mesh as mesh_lib
 
 STAGE_AXIS = "stages"
 
@@ -235,10 +236,10 @@ class PipelinedLM:
                     lambda _: NamedSharding(mesh, P(STAGE_AXIS)),
                     params["blocks"]),
             }
-            return jax.device_put(params, shardings)
+            return mesh_lib.put_global(params, shardings)
 
         def place_batch(batch):
-            return jax.device_put(batch, NamedSharding(mesh, P()))
+            return mesh_lib.put_global(batch, NamedSharding(mesh, P()))
 
         return step_fn, place_params, place_batch
 
@@ -396,11 +397,11 @@ class GenericPipeline:
         step_fn = jax.jit(step, donate_argnums=(0, 1))
 
         def place_params(params):
-            return jax.device_put(
+            return mesh_lib.put_global(
                 params, jax.tree.map(lambda _: NamedSharding(mesh, P()),
                                      params))
 
         def place_batch(batch):
-            return jax.device_put(batch, NamedSharding(mesh, P()))
+            return mesh_lib.put_global(batch, NamedSharding(mesh, P()))
 
         return step_fn, place_params, place_batch

@@ -223,4 +223,4 @@ def stage_epoch_chunks(shards, features_col: str, label_col: str,
                 for a in arrs[key]], axis=1)
 
         data = {key: stack(key) for key in cols}
-        yield jax.device_put(data, sharding), cnt
+        yield mesh_lib.put_global(data, sharding), cnt

@@ -95,7 +95,7 @@ def shard_params(params: Any, mesh: Mesh,
                  rules: Optional[Rules] = None) -> Any:
     """Place ``params`` on the mesh according to the rules."""
     specs = partition_specs(params, rules, mesh)
-    return jax.device_put(
+    return mesh_lib.put_global(
         params, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                              is_leaf=lambda x: isinstance(x, P)))
 
@@ -169,15 +169,15 @@ def build_pjit_epoch_fn(model, loss, tx: optax.GradientTransformation,
             return jax.tree.map(lambda _: NamedSharding(mesh, P()), sub)
 
         return engine.TrainState(
-            step=jax.device_put(state.step, NamedSharding(mesh, P())),
+            step=mesh_lib.put_global(state.step, NamedSharding(mesh, P())),
             params=shard_params(state.params, mesh, rules),
-            opt_state=jax.device_put(
+            opt_state=mesh_lib.put_global(
                 state.opt_state,
                 jax.tree.map(opt_subtree_shardings, state.opt_state,
                              is_leaf=params_like)))
 
     def place_data(data):
-        return jax.device_put(data, data_sharding)
+        return mesh_lib.put_global(data, data_sharding)
 
     epoch_fn = jax.jit(epoch, donate_argnums=(0,))
     return epoch_fn, place_state, place_data

@@ -159,7 +159,7 @@ class Trainer:
                 f"Staging the whole epoch device-resident "
                 f"(~{total / 2**30:.1f} GiB). Pass {knob}= to bound device "
                 f"data memory to O(chunk) with background prefetch.",
-                ResourceWarning, stacklevel=3)
+                RuntimeWarning, stacklevel=3)
 
     @staticmethod
     def _epoch_chunk_stream(staged, make_gen, resident: bool):
