@@ -102,10 +102,10 @@ def build_sp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     jitted = jax.jit(shmapped, donate_argnums=(0, 1) if donate else ())
 
     def place_state(state):
-        return jax.device_put(state, NamedSharding(mesh, P()))
+        return mesh_lib.put_global(state, NamedSharding(mesh, P()))
 
     def place_batch(batch):
-        return jax.device_put(batch, NamedSharding(mesh, data_spec))
+        return mesh_lib.put_global(batch, NamedSharding(mesh, data_spec))
 
     return step_fn, place_state, place_batch
 
@@ -122,4 +122,4 @@ def init_sp_state(model, tx, mesh, batch_shape: Tuple[int, int],
                        train=False)["params"]
     state = engine.TrainState(step=jnp.zeros((), jnp.int32), params=params,
                               opt_state=tx.init(params))
-    return jax.device_put(state, NamedSharding(mesh, P()))
+    return mesh_lib.put_global(state, NamedSharding(mesh, P()))

@@ -43,6 +43,27 @@ def device_get_batched(tree):
     huge history tree cannot produce an unboundedly wide XLA program.
     """
     leaves, treedef = jax.tree_util.tree_flatten(tree)
+    na_idx = [i for i, l in enumerate(leaves)
+              if isinstance(l, jax.Array) and not l.is_fully_addressable]
+    if na_idx:
+        # multi-process mesh: make those leaves fully addressable with ONE
+        # compiled replication per mesh (the collective crosses hosts),
+        # leaving every other leaf untouched, then fall through to the
+        # batched transfer below. Grouped by mesh: out_shardings must
+        # share one.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        by_mesh: dict = {}
+        for i in na_idx:
+            by_mesh.setdefault(leaves[i].sharding.mesh, []).append(i)
+        for m, ids in by_mesh.items():
+            rep = jax.jit(
+                lambda *xs: xs,
+                out_shardings=NamedSharding(m, PartitionSpec()))(
+                    *[leaves[i] for i in ids])
+            for i, r in zip(ids, rep):
+                leaves[i] = r
+        tree = jax.tree_util.tree_unflatten(treedef, leaves)
     array_idx = [i for i, l in enumerate(leaves)
                  if isinstance(l, jax.Array) and l.size > 0]
     if len(array_idx) <= 2:

@@ -8,10 +8,41 @@ spanning both, and run a cross-process ``psum`` whose result proves the
 collective crossed the process boundary.
 """
 
+import os
 import socket
 import subprocess
 import sys
 import textwrap
+
+
+def _run_two_procs(tmp_path, worker_src: str, timeout: int = 240) -> list:
+    """Spawn two coordinated worker processes; return their outputs.
+
+    Children are killed in a finally block so a hung collective cannot
+    orphan processes holding the coordinator port for the rest of the run.
+    """
+    script = tmp_path / "worker.py"
+    script.write_text(worker_src)
+    with socket.socket() as s:  # pick a free port
+        s.bind(("127.0.0.1", 0))
+        port = str(s.getsockname()[1])
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(pid), port, repo],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for pid in (0, 1)]
+    try:
+        outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+    return outs
+
 
 WORKER = textwrap.dedent("""
     import os, sys
@@ -47,23 +78,7 @@ WORKER = textwrap.dedent("""
 
 
 def test_two_process_coordination_and_cross_process_psum(tmp_path):
-    script = tmp_path / "worker.py"
-    script.write_text(WORKER)
-    with socket.socket() as s:  # pick a free port
-        s.bind(("127.0.0.1", 0))
-        port = str(s.getsockname()[1])
-    import os
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    procs = [subprocess.Popen(
-        [sys.executable, str(script), str(pid), port, repo],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env=env) for pid in (0, 1)]
-    outs = [p.communicate(timeout=240)[0] for p in procs]
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, out[-2000:]
+    outs = _run_two_procs(tmp_path, WORKER)
     assert "OK proc=0 psum=12.0" in outs[0]
     assert "OK proc=1 psum=12.0" in outs[1]
 
@@ -120,24 +135,9 @@ def test_two_process_adag_epoch_matches_single_process(tmp_path):
     """One ADAG epoch (8 workers, psum center fold) across TWO processes
     equals the same epoch on one process's virtual 8-device mesh — the
     distributed communication backend really is process-transparent."""
-    import os
     import re
 
-    script = tmp_path / "train_worker.py"
-    script.write_text(TRAIN_WORKER)
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = str(s.getsockname()[1])
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    procs = [subprocess.Popen(
-        [sys.executable, str(script), str(pid), port, repo],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env=env) for pid in (0, 1)]
-    outs = [p.communicate(timeout=240)[0] for p in procs]
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, out[-2000:]
+    outs = _run_two_procs(tmp_path, TRAIN_WORKER)
     vals = {}
     for out in outs:
         m = re.search(r"TRAINOK proc=(\d) loss=([\d.]+) checksum=([\d.]+)",
@@ -180,3 +180,72 @@ def test_two_process_adag_epoch_matches_single_process(tmp_path):
     loss_mh, checksum_mh = vals["0"]
     np.testing.assert_allclose(loss_mh, loss_ref, rtol=1e-5)
     np.testing.assert_allclose(checksum_mh, checksum_ref, rtol=1e-5)
+
+
+FULL_TRAINER_WORKER = textwrap.dedent("""
+    import os, sys
+    pid = int(sys.argv[1]); port = sys.argv[2]; repo = sys.argv[3]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    sys.path.insert(0, repo)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from distkeras_tpu.parallel import distributed
+    distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=2, process_id=pid)
+    import numpy as np
+    from distkeras_tpu import ADAG
+    from distkeras_tpu.data.dataset import synthetic_mnist
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.parallel.distributed import multihost_mesh
+
+    # the PUBLIC trainer API, unchanged, on a mesh spanning 2 processes
+    t = ADAG(MLP(features=(16,)), worker_optimizer="sgd",
+             learning_rate=0.05, metrics=(), batch_size=8,
+             communication_window=2, num_epoch=2,
+             mesh=multihost_mesh(num_workers=8))
+    t.train(synthetic_mnist(n=512))
+    losses = [round(h["loss"], 6) for h in t.history]
+    checksum = float(sum(np.abs(np.asarray(l)).sum()
+                         for l in jax.tree.leaves(t.params)))
+    print(f"FULLOK proc={pid} h0={losses[0]} hN={losses[-1]} "
+          f"n={len(losses)} checksum={checksum:.6f}")
+""")
+
+
+def test_two_process_full_trainer_matches_single_process(tmp_path):
+    """The PUBLIC ADAG trainer — staging, epochs, metric recording, final
+    param fetch — runs unchanged on a two-process mesh and reproduces the
+    single-process trajectory."""
+    import re
+
+    outs = _run_two_procs(tmp_path, FULL_TRAINER_WORKER, timeout=300)
+    vals = {}
+    for out in outs:
+        m = re.search(r"FULLOK proc=(\d) h0=([\d.]+) hN=([\d.]+) n=(\d+) "
+                      r"checksum=([\d.]+)", out)
+        assert m, out[-2000:]
+        vals[m.group(1)] = tuple(float(x) for x in m.groups()[1:])
+    assert vals["0"] == vals["1"]
+
+    # single-process oracle through the same public API
+    import numpy as np
+
+    from distkeras_tpu import ADAG
+    from distkeras_tpu.data.dataset import synthetic_mnist
+    from distkeras_tpu.models.mlp import MLP
+
+    t = ADAG(MLP(features=(16,)), worker_optimizer="sgd",
+             learning_rate=0.05, metrics=(), batch_size=8,
+             communication_window=2, num_epoch=2, num_workers=8)
+    t.train(synthetic_mnist(n=512))
+    import jax
+
+    h0, hN, n, checksum = vals["0"]
+    assert n == len(t.history)
+    np.testing.assert_allclose(h0, t.history[0]["loss"], rtol=1e-4)
+    np.testing.assert_allclose(hN, t.history[-1]["loss"], rtol=1e-4)
+    ref = float(sum(np.abs(np.asarray(l)).sum()
+                    for l in jax.tree.leaves(t.params)))
+    np.testing.assert_allclose(checksum, ref, rtol=1e-5)
