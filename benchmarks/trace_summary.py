@@ -1,0 +1,95 @@
+"""Summarize a jax.profiler Chrome trace by HLO category and top ops.
+
+The round-3 MFU work ran on exactly this aggregation (DESIGN.md §4b): it
+turns `observability.profiler_trace(logdir)` output into the table that
+says whether a step is MXU-bound or HBM-bound and which fusions to
+attack. Kept as a tool so future profiling sessions don't rebuild it.
+
+Usage:
+  python benchmarks/trace_summary.py <logdir-or-trace.json.gz> [--top N]
+
+Works on the ``*.trace.json.gz`` the TPU profiler writes next to its
+xplane file; no tensorboard or profile plugin needed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def find_trace(path: str) -> str:
+    if os.path.isfile(path):
+        return path
+    hits = sorted(glob.glob(os.path.join(
+        path, "**", "*.trace.json.gz"), recursive=True))
+    if not hits:
+        sys.exit(f"no *.trace.json.gz under {path}")
+    return hits[-1]  # newest capture
+
+
+def load_device_events(trace_path: str) -> list:
+    with gzip.open(trace_path) as f:
+        data = json.load(f)
+    events = data["traceEvents"]
+    device_pids = {e["pid"] for e in events
+                   if e.get("ph") == "M" and e.get("name") == "process_name"
+                   and "TPU" in (e["args"].get("name") or "")}
+    # ops live on the tid that carries hlo_category args
+    return [e for e in events
+            if e.get("ph") == "X" and e["pid"] in device_pids
+            and (e.get("args") or {}).get("hlo_category")]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("path", help="profiler logdir or trace.json.gz")
+    ap.add_argument("--top", type=int, default=15)
+    args = ap.parse_args()
+    trace = find_trace(args.path)
+    events = load_device_events(trace)
+    if not events:
+        sys.exit(f"{trace}: no device op events with hlo_category")
+
+    cat_ms = collections.Counter()
+    cat_flops = collections.Counter()
+    cat_bytes = collections.Counter()
+    ops: dict = {}
+    for e in events:
+        a = e["args"]
+        c = a["hlo_category"]
+        if c == "while":  # parent wrapper double-counts its children
+            continue
+        d_ms = int(a.get("device_duration_ps", 0)) / 1e9
+        cat_ms[c] += d_ms
+        cat_flops[c] += int(a.get("model_flops", 0) or 0)
+        cat_bytes[c] += int(a.get("raw_bytes_accessed", 0) or 0)
+        rec = ops.setdefault(e["name"], [0.0, c, a.get("long_name", "")])
+        rec[0] += d_ms
+
+    total = sum(cat_ms.values())
+    print(f"# {trace}")
+    print(f"# total device op time: {total:.2f} ms\n")
+    print(f"{'category':28s} {'ms':>9s} {'%':>6s} {'TFLOP/s':>8s} "
+          f"{'GB/s':>7s}")
+    for c, ms in cat_ms.most_common():
+        s = ms / 1e3
+        tf = cat_flops[c] / s / 1e12 if s else 0.0
+        gb = cat_bytes[c] / s / 1e9 if s else 0.0
+        print(f"{c:28s} {ms:9.2f} {ms / total * 100:6.1f} {tf:8.1f} "
+              f"{gb:7.0f}")
+    print(f"\n# top {args.top} ops:")
+    for name, (ms, c, long_name) in sorted(
+            ops.items(), key=lambda kv: -kv[1][0])[:args.top]:
+        print(f"{ms:9.3f} ms  {c:24s} {name}")
+        if long_name:
+            print(f"           {long_name[:120]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -14,7 +14,7 @@ of the single RTT.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +33,16 @@ def _concat(n: int, *arrs):
 _MAX_CONCAT_ARGS = 1024
 
 
+@lru_cache(maxsize=32)
+def _replicator(mesh):
+    """Per-mesh cached jitted identity with replicated out_shardings —
+    the cross-host gather of the multi-process fetch path."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.jit(lambda *xs: xs,
+                   out_shardings=NamedSharding(mesh, PartitionSpec()))
+
+
 def device_get_batched(tree):
     """``jax.device_get`` with per-dtype batched transfers.
 
@@ -46,23 +56,23 @@ def device_get_batched(tree):
     na_idx = [i for i, l in enumerate(leaves)
               if isinstance(l, jax.Array) and not l.is_fully_addressable]
     if na_idx:
-        # multi-process mesh: make those leaves fully addressable with ONE
+        # multi-process mesh: make those leaves fully addressable with a
         # compiled replication per mesh (the collective crosses hosts),
         # leaving every other leaf untouched, then fall through to the
-        # batched transfer below. Grouped by mesh: out_shardings must
-        # share one.
-        from jax.sharding import NamedSharding, PartitionSpec
-
+        # batched transfer below. The jitted identity is cached per mesh
+        # (fresh jit objects would retrace every call) and fed at most
+        # _MAX_CONCAT_ARGS leaves per invocation (same wide-program bound
+        # as the concat path).
         by_mesh: dict = {}
         for i in na_idx:
             by_mesh.setdefault(leaves[i].sharding.mesh, []).append(i)
         for m, ids in by_mesh.items():
-            rep = jax.jit(
-                lambda *xs: xs,
-                out_shardings=NamedSharding(m, PartitionSpec()))(
-                    *[leaves[i] for i in ids])
-            for i, r in zip(ids, rep):
-                leaves[i] = r
+            rep_fn = _replicator(m)
+            for lo in range(0, len(ids), _MAX_CONCAT_ARGS):
+                chunk = ids[lo:lo + _MAX_CONCAT_ARGS]
+                rep = rep_fn(*[leaves[i] for i in chunk])
+                for i, r in zip(chunk, rep):
+                    leaves[i] = r
         tree = jax.tree_util.tree_unflatten(treedef, leaves)
     array_idx = [i for i, l in enumerate(leaves)
                  if isinstance(l, jax.Array) and l.size > 0]
