@@ -89,8 +89,10 @@ class HostAsyncRunner:
     """Run N concurrent workers against a live parameter server.
 
     ``shards``: per-worker lists of staged batch dicts (features/labels),
-    each leaf [window, batch, ...]. History and staleness are recorded
-    per-worker and merged in commit order.
+    each leaf [window, batch, ...]. Each window's metrics are tagged with
+    the server clock at its commit; the returned history/staleness are the
+    windows sorted by that clock — true commit order, not worker-major
+    concatenation.
     """
 
     def __init__(self, model, loss, tx, strategy: Strategy, window: int,
@@ -104,23 +106,61 @@ class HostAsyncRunner:
         # worker k runs on devices[k % D]; default = single-device mode
         self.devices = list(devices) if devices else [jax.devices()[0]]
         self.worker_devices: list = []  # actual placement, for tests/logs
+        self.window_clocks: list = []   # merged commit clocks, last run
 
-    def run(self, init_params, epoch_shards: Sequence[Sequence[Sequence[dict]]]
-            ) -> tuple:
+    def run(self, init_params, epoch_shards: Sequence[Sequence[Sequence[dict]]],
+            checkpointer=None, checkpoint_folds: int = 0,
+            start_clock: int = 0) -> tuple:
         """``epoch_shards[epoch][worker]`` is that worker's list of staged
         rounds for that epoch (per-epoch staging preserves the sync path's
         reshuffle-every-epoch semantics; pass the same object per epoch when
         not shuffling). Workers progress through epochs without barriers —
-        true asynchrony extends across epoch boundaries too."""
+        true asynchrony extends across epoch boundaries too.
+
+        ``checkpointer``/``checkpoint_folds``: snapshot the live center +
+        server clock every ``checkpoint_folds`` commits (the async-mode
+        fault-tolerance story — there is no epoch barrier to snapshot at).
+        A dedicated saver thread does the pull + device→host fetch + (async
+        Orbax) save; committing workers only set an event, so they never
+        stall on checkpoint IO (an in-commit-path save would skew the real
+        scheduling this mode exists to measure). The PS lock makes each
+        pulled snapshot internally consistent. ``start_clock`` seeds the
+        server clock when resuming from such a snapshot."""
         num_workers = len(epoch_shards[0])
         # center (and its folds) live on device 0; workers pull it across
         ps = server_for(self.strategy,
                         jax.device_put(init_params, self.devices[0]))
-        histories: list[list[dict]] = [[] for _ in range(num_workers)]
-        staleness: list[list[int]] = [[] for _ in range(num_workers)]
+        ps.num_updates = int(start_clock)
+        # per-window records: (commit_clock, staleness, [per-step metrics])
+        windows: list[list[tuple]] = [[] for _ in range(num_workers)]
         errors: list = []
         self.worker_devices = [self.devices[k % len(self.devices)]
                                for k in range(num_workers)]
+        save_trigger = threading.Event()
+        stop_saving = threading.Event()
+
+        def saver():
+            """Best-effort periodic snapshots, serialized in one thread.
+            Cadence crossings that arrive while a save is in flight coalesce
+            into the next snapshot (which sees a newer clock anyway)."""
+            last_saved = int(start_clock)
+            try:
+                while True:
+                    fired = save_trigger.wait(timeout=0.05)
+                    if fired:
+                        save_trigger.clear()
+                    elif stop_saving.is_set():
+                        return
+                    else:
+                        continue
+                    center, clock = ps.pull()  # consistent under the PS lock
+                    if clock > last_saved:
+                        checkpointer.save(
+                            clock, {"center": device_get_batched(center),
+                                    "clock": np.array([clock], np.int64)})
+                        last_saved = clock
+            except Exception as e:  # surface save failures to the caller
+                errors.append(e)
 
         def worker(k: int):
             try:
@@ -137,27 +177,42 @@ class HostAsyncRunner:
                             np.int32(k * 1_000_003 + fold))
                         jax.block_until_ready(commit)
                         clock_at_fold = ps.commit(commit, last_update=clock)
-                        staleness[k].append(clock_at_fold - clock)
                         ms = device_get_batched(ms)
                         n = len(ms["loss"])
-                        histories[k].extend(
-                            {key: float(v[i]) for key, v in ms.items()}
-                            for i in range(n))
+                        windows[k].append((
+                            clock_at_fold, clock_at_fold - clock,
+                            [{key: float(v[i]) for key, v in ms.items()}
+                             for i in range(n)]))
+                        if checkpointing and \
+                                (clock_at_fold + 1) % checkpoint_folds == 0:
+                            save_trigger.set()  # non-blocking hand-off
                         fold += 1
             except Exception as e:  # surface thread failures to the caller
                 errors.append(e)
 
+        checkpointing = checkpointer is not None and checkpoint_folds > 0
+        saver_thread = None
+        if checkpointing:
+            saver_thread = threading.Thread(target=saver, daemon=True)
+            saver_thread.start()
         threads = [threading.Thread(target=worker, args=(k,), daemon=True)
                    for k in range(num_workers)]
         for t in threads:
             t.start()
         for t in threads:
             t.join()
+        if saver_thread is not None:
+            stop_saving.set()
+            saver_thread.join()
         if errors:
             raise errors[0]
         center, _ = ps.pull()
-        history = [h for hs in histories for h in hs]
-        stal = [float(s) for ss in staleness for s in ss]
+        # merge worker windows by the server clock at their commit — the
+        # wall-clock order the center actually absorbed them in
+        merged = sorted((w for ws in windows for w in ws), key=lambda w: w[0])
+        self.window_clocks = [w[0] for w in merged]  # for tests/diagnostics
+        history = [step for _, _, steps in merged for step in steps]
+        stal = [float(s) for _, s, _ in merged]
         return device_get_batched(center), history, stal, ps.num_updates
 
 

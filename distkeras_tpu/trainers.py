@@ -208,6 +208,7 @@ class DistributedTrainer(Trainer):
                  master_port: Optional[int] = None,  # parity no-op
                  mesh=None, seed: int = 0, mode: str = "sync",
                  checkpoint_dir: Optional[str] = None,
+                 checkpoint_folds: Optional[int] = None,
                  staging_rounds: Optional[int] = None,
                  devices=None,
                  **strategy_kwargs):
@@ -249,6 +250,19 @@ class DistributedTrainer(Trainer):
             # `factor` stacked replicas (see substrate.build_epoch_fn)
             self.num_workers = (self.mesh.shape[mesh_lib.WORKER_AXIS]
                                 * self.parallelism_factor)
+        if checkpoint_folds is not None and mode != "host_async":
+            raise ValueError(
+                "checkpoint_folds is the host_async snapshot cadence; sync "
+                "mode checkpoints at epoch boundaries (checkpoint_dir alone)")
+        if checkpoint_folds is not None and checkpoint_dir is None:
+            raise ValueError(
+                "checkpoint_folds sets the snapshot cadence but "
+                "checkpoint_dir is None — there is nowhere to save; pass "
+                "checkpoint_dir too (silently taking no snapshots would "
+                "defeat the fault tolerance you asked for)")
+        # host_async snapshot cadence (commits between snapshots); defaults
+        # to one full round of folds (num_workers) when checkpointing is on
+        self.checkpoint_folds = checkpoint_folds
         self.communication_window = int(communication_window)
         # None: stage the whole epoch device-resident (fastest for data that
         # fits). An int bounds staging memory to O(staging_rounds) with
@@ -299,16 +313,12 @@ class DistributedTrainer(Trainer):
         from distkeras_tpu.parallel import substrate
 
         if self.mode == "host_async":
-            if self.checkpoint_dir is not None:
-                raise ValueError(
-                    "checkpoint_dir is not supported in host_async mode "
-                    "(no epoch barrier to snapshot at); use mode='sync'")
             if self.staging_rounds is not None:
                 raise ValueError(
                     "staging_rounds is not supported in host_async mode "
                     "(worker threads stage their shards host-resident); "
                     "use mode='sync' for O(chunk) staging")
-            return self._train_host_async(dataset, shuffle)
+            return self._train_host_async(dataset, shuffle, resume)
         self._start()
         self._check_trainable(
             dataset, self.batch_size * self.communication_window * self.num_workers)
@@ -376,10 +386,18 @@ class DistributedTrainer(Trainer):
         """Async trainers return the parameter server's center variable."""
         return device_get_batched(center)
 
-    def _train_host_async(self, dataset: Dataset, shuffle: bool):
+    def _train_host_async(self, dataset: Dataset, shuffle: bool,
+                          resume: bool = False):
         """True wall-clock asynchrony: thread-per-worker against a live PS
         (parallel/host_async.py). Staleness here is real scheduling, not the
-        sync substrate's deterministic rotation."""
+        sync substrate's deterministic rotation.
+
+        Checkpointing has no epoch barrier here; instead the PS center +
+        server clock are snapshotted every ``checkpoint_folds`` commits
+        (default: one full round, ``num_workers`` folds). ``resume=True``
+        restores the latest snapshot: workers restart their data passes from
+        the beginning, but pull the restored center and continue its clock —
+        the same semantics as a reference worker rejoining a live server."""
         from distkeras_tpu.parallel import host_async
 
         self._start()
@@ -387,6 +405,18 @@ class DistributedTrainer(Trainer):
             dataset,
             self.batch_size * self.communication_window * self.num_workers)
         state = self._init_params(dataset)
+        init_params, start_clock = state.params, 0
+        ckpt = self._checkpointer()
+        if ckpt is not None:
+            try:
+                snap, _ = self._maybe_resume(
+                    ckpt, {"center": init_params,
+                           "clock": np.zeros((1,), np.int64)}, resume)
+            except BaseException:
+                ckpt.close()
+                raise
+            init_params = snap["center"]
+            start_clock = int(np.asarray(snap["clock"])[0])
 
         def stage(ds):
             return host_async.stage_worker_shards(
@@ -404,8 +434,29 @@ class DistributedTrainer(Trainer):
                 self.communication_window, self.metrics, self.seed,
                 devices=self.devices or jax.devices())
         runner = self._async_runner
-        params, history, staleness, num_updates = runner.run(
-            state.params, epoch_shards)
+        try:
+            params, history, staleness, num_updates = runner.run(
+                init_params, epoch_shards,
+                checkpointer=ckpt,
+                checkpoint_folds=(self.checkpoint_folds or self.num_workers)
+                if ckpt is not None else 0,
+                start_clock=start_clock)
+        except BaseException:
+            if ckpt is not None:  # crash path: finalize in-flight snapshots
+                try:              # so resume sees the last completed one
+                    ckpt.wait()
+                finally:          # close even if the flush itself fails, and
+                    ckpt.close()  # let the TRAINING error propagate
+            raise
+        if ckpt is not None:
+            # final snapshot so a completed run is always resumable from its
+            # end state, then flush the async saves
+            if num_updates > (ckpt.latest_step() or 0):
+                ckpt.save(num_updates,  # runner already fetched params to host
+                          {"center": params,
+                           "clock": np.array([num_updates], np.int64)})
+            ckpt.wait()
+            ckpt.close()
         self.history = history
         self.staleness_history = staleness
         self.num_updates = num_updates
@@ -444,11 +495,24 @@ class AEASGD(DistributedTrainer):
 
 class EAMSGD(DistributedTrainer):
     """Elastic averaging with Nesterov momentum on the local replicas.
-    Extra kwargs: rho, momentum."""
+    Extra kwargs: rho, momentum.
+
+    The local step is the explicit Nesterov rule (η, μ) — momentum lives in
+    the worker loop, matching the reference's dedicated EAMSGD worker — so
+    ``worker_optimizer`` is NOT applied. Passing a non-default optimizer is
+    rejected rather than silently ignored."""
 
     strategy_name = "eamsgd"
 
     def __init__(self, model, rho: float = 5.0, momentum: float = 0.9, **kw):
+        opt = kw.get("worker_optimizer", "sgd")
+        if opt != "sgd":
+            raise ValueError(
+                f"EAMSGD ignores worker_optimizer (its local step is the "
+                f"explicit Nesterov rule v ← μv − η∇f(w + μv); see "
+                f"NUMERICS.md), so worker_optimizer={opt!r} would silently "
+                f"not be what you asked for. Leave it at the default, or "
+                f"use AEASGD if you want an optax worker optimizer.")
         super().__init__(model, rho=rho, momentum=momentum, **kw)
 
 

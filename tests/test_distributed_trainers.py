@@ -27,6 +27,14 @@ COMMON = dict(loss="categorical_crossentropy", learning_rate=0.05,
               communication_window=2)
 
 
+def test_eamsgd_rejects_non_default_worker_optimizer():
+    """EAMSGD's local step is the explicit Nesterov rule; a worker_optimizer
+    would be silently ignored, so passing one must fail loudly."""
+    with pytest.raises(ValueError, match="worker_optimizer"):
+        EAMSGD(_model(), **COMMON, worker_optimizer="adam")
+    EAMSGD(_model(), **COMMON, worker_optimizer="sgd")  # default: fine
+
+
 @pytest.mark.parametrize("cls,extra", [
     (DOWNPOUR, {}),
     (ADAG, {}),

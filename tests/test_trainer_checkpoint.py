@@ -75,13 +75,14 @@ def test_pjit_trainer_resume(tmp_path):
     _params_equal(p_full, p_resumed, rtol=1e-5)
 
 
-def test_host_async_rejects_checkpoint_dir(tmp_path):
+def test_sync_mode_rejects_checkpoint_folds():
+    """checkpoint_folds is the host_async snapshot cadence; sync mode
+    checkpoints at epoch boundaries (host_async checkpointing itself is
+    covered by tests/test_host_async.py kill-and-resume)."""
     from distkeras_tpu import DOWNPOUR
 
-    t = DOWNPOUR(_model(), mode="host_async", num_workers=2,
-                 checkpoint_dir=str(tmp_path / "d"))
-    with pytest.raises(ValueError, match="host_async"):
-        t.train(synthetic_mnist(n=256))
+    with pytest.raises(ValueError, match="checkpoint_folds"):
+        DOWNPOUR(_model(), num_workers=2, checkpoint_folds=4)
 
 
 def test_fresh_run_on_stale_checkpoint_dir_raises(tmp_path):
