@@ -1,4 +1,10 @@
-from distkeras_tpu.data.dataset import Dataset, ShardedColumn, synthetic_mnist
+from distkeras_tpu.data.dataset import (
+    Dataset,
+    PermutedColumn,
+    ShardedColumn,
+    synthetic_mnist,
+)
 from distkeras_tpu.data.prefetch import prefetch
 
-__all__ = ["Dataset", "ShardedColumn", "prefetch", "synthetic_mnist"]
+__all__ = ["Dataset", "PermutedColumn", "ShardedColumn", "prefetch",
+           "synthetic_mnist"]

@@ -72,10 +72,71 @@ class ShardedColumn:
             i = int(key) + (len(self) if key < 0 else 0)
             part = int(np.searchsorted(self._offsets, i, side="right")) - 1
             return self.parts[part][i - self._offsets[part]]
-        return np.asarray(self)[key]  # fancy indexing materializes
+        idx = np.asarray(key)
+        if idx.ndim == 1 and \
+                (np.issubdtype(idx.dtype, np.integer) or idx.size == 0):
+            # per-part gather: reads O(len(idx)) rows from disk, never the
+            # whole column (memmap fancy indexing touches only those pages)
+            idx = idx.astype(np.int64, copy=False)
+            if idx.size and (idx.min() < -len(self) or
+                             idx.max() >= len(self)):
+                raise IndexError(
+                    f"index out of bounds for ShardedColumn of "
+                    f"length {len(self)}: {key!r}")
+            idx = np.where(idx < 0, idx + len(self), idx)
+            out = np.empty((len(idx),) + self.parts[0].shape[1:], self.dtype)
+            part_of = np.searchsorted(self._offsets, idx, side="right") - 1
+            for p in np.unique(part_of):
+                m = part_of == p
+                out[m] = self.parts[p][idx[m] - self._offsets[p]]
+            return out
+        return np.asarray(self)[key]  # boolean/N-d keys materialize
 
 
-ColumnLike = Union[np.ndarray, ShardedColumn]
+class PermutedColumn:
+    """Lazy row-permuted view of a (possibly file-backed) column.
+
+    ``shuffle()`` on a lazy column keeps the O(n) permutation INDEX
+    (8 bytes/row — trivial even at ImageNet scale) but defers the row
+    gather: slicing returns another lazy view, and only materialization
+    (``np.asarray`` of a chunk/batch slice) reads the underlying rows —
+    O(slice) disk reads, never the whole column. Sample order is
+    bit-identical to the materializing shuffle: the same
+    ``rng.permutation`` indices, applied late instead of eagerly.
+    """
+
+    def __init__(self, base, perm: np.ndarray):
+        self.base = base
+        self.perm = np.asarray(perm)
+
+    def __len__(self) -> int:
+        return len(self.perm)
+
+    @property
+    def shape(self):
+        return (len(self.perm),) + tuple(self.base.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.base.dtype
+
+    def _gather(self, idx: np.ndarray) -> np.ndarray:
+        # memmap / ShardedColumn fancy indexing reads O(len(idx)) rows
+        return np.asarray(self.base[idx])
+
+    def __array__(self, dtype=None, copy=None):
+        out = self._gather(self.perm)
+        return out.astype(dtype) if dtype is not None else out
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            return PermutedColumn(self.base, self.perm[key])  # stays lazy
+        if np.isscalar(key) or isinstance(key, (int, np.integer)):
+            return self.base[int(self.perm[key])]
+        return self._gather(self.perm[np.asarray(key)])
+
+
+ColumnLike = Union[np.ndarray, ShardedColumn, PermutedColumn]
 
 
 class Dataset:
@@ -88,10 +149,11 @@ class Dataset:
         if len(n) != 1:
             raise ValueError(f"Column length mismatch: "
                              f"{ {k: len(v) for k, v in columns.items()} }")
-        # ShardedColumns and memmaps pass through un-materialized (memmap
-        # is kept as its own type so laziness stays visible downstream)
+        # ShardedColumns, memmaps and PermutedColumns pass through
+        # un-materialized (memmap is kept as its own type so laziness stays
+        # visible downstream)
         self._columns = {
-            k: v if isinstance(v, (ShardedColumn, np.memmap))
+            k: v if isinstance(v, (ShardedColumn, np.memmap, PermutedColumn))
             else np.asarray(v)
             for k, v in columns.items()}
 
@@ -123,17 +185,27 @@ class Dataset:
 
     # -- distribution-shaped ops -------------------------------------------
     def shuffle(self, seed: int = 0) -> "Dataset":
-        """utils.shuffle(df) parity, but deterministic by seed. The row
-        gather runs through the native threaded assembler when available
-        (data/native.py); indices are identical either way, so numerics
-        do not depend on which path executed."""
+        """utils.shuffle(df) parity, but deterministic by seed.
+
+        In-memory columns are gathered eagerly (through the native threaded
+        assembler when available, data/native.py). File-backed columns
+        (memmap / ShardedColumn) become lazy :class:`PermutedColumn` views —
+        the streaming shuffle: only the permutation index (8 bytes/row) is
+        materialized now; rows are read from disk O(chunk) at a time as the
+        staging layer slices them. Indices are identical on every path, so
+        numerics do not depend on which one executed."""
         from distkeras_tpu.data import native
 
         perm = rng.permutation(seed, len(self))
-        # NB: a row gather materializes the whole dataset; for file-backed
-        # data prefer pre-shuffled shard files (see Dataset.from_files)
-        return Dataset({k: native.gather_rows(np.asarray(v), perm)
-                        for k, v in self._columns.items()})
+        out: Dict[str, ColumnLike] = {}
+        for k, v in self._columns.items():
+            if isinstance(v, PermutedColumn):
+                out[k] = PermutedColumn(v.base, v.perm[perm])  # compose lazily
+            elif isinstance(v, (ShardedColumn, np.memmap)):
+                out[k] = PermutedColumn(v, perm)
+            else:
+                out[k] = native.gather_rows(np.asarray(v), perm)
+        return Dataset(out)
 
     def repartition(self, num_partitions: int) -> List["Dataset"]:
         """Split into contiguous near-equal shards (Spark repartition parity;
@@ -183,8 +255,10 @@ class Dataset:
         :class:`ShardedColumn` — shard boundaries need not align with
         worker or chunk boundaries.
 
-        ``shuffle()`` on a file-backed dataset materializes it (row
-        gather); for big data, pre-shuffle the shard files instead.
+        ``shuffle()`` on a file-backed dataset is a streaming shuffle: it
+        returns lazy :class:`PermutedColumn` views and rows are read from
+        disk O(chunk) at a time during staging (random-access reads; for
+        spinning disks, pre-shuffled shard files are still friendlier).
         """
         cols: Dict[str, ColumnLike] = {}
         mode = "r" if mmap else None

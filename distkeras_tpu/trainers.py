@@ -539,8 +539,8 @@ class EnsembleTrainer(DistributedTrainer):
     def _setup_state(self, dataset: Dataset):
         from distkeras_tpu.parallel import mesh as mesh_lib
 
-        col = np.asarray(dataset[self.features_col])
-        sample = np.zeros((1,) + col.shape[1:], col.dtype)
+        col = dataset[self.features_col]  # shape/dtype only — stays lazy
+        sample = np.zeros((1,) + tuple(col.shape[1:]), col.dtype)
         keys = jax.random.split(jax.random.key(self.seed), self.num_workers)
 
         def init_one(k):
