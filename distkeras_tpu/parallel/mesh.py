@@ -84,6 +84,74 @@ def put_global(tree, sharding):
     return jax.tree.map(put, tree, sharding)
 
 
+def local_worker_positions(mesh: Mesh) -> list:
+    """Worker-axis positions with at least one device owned by this process.
+
+    Under the host-sharded data contract each process stages data only for
+    these positions (its "addressable workers") — the TPU-native analogue of
+    a Spark executor reading only its partitions. With one process this is
+    every position, so host-sharded staging degrades to the ordinary case.
+    """
+    pi = jax.process_index()
+    grid = mesh.devices  # (workers, model, ...)
+    return [w for w in range(grid.shape[0])
+            if any(d.process_index == pi for d in np.ravel(grid[w]))]
+
+
+def put_host_sharded(tree_local, sharding: NamedSharding,
+                     mesh_workers: int, local_positions: Sequence[int]):
+    """Place round-major data (axis 1 = workers) when this process holds
+    ONLY its own workers' rows.
+
+    ``mesh_workers`` is the worker AXIS size D (mesh positions, not logical
+    workers). ``tree_local`` leaves are (rounds, len(local_positions)·f,
+    ...) — this process's worker columns in ``local_positions`` order, each
+    position contributing its ``f`` stacked logical workers
+    (oversubscription factor, inferred from the local block; the global
+    worker axis is then D·f logical workers). Every addressable device's
+    shard is sliced out of the local block and placed with
+    ``make_array_from_single_device_arrays`` — no process ever
+    materializes another host's rows, unlike :func:`put_global` which
+    requires the full array on every host.
+    """
+    local_positions = list(local_positions)
+
+    def put(x_local):
+        n_local = x_local.shape[1]
+        if n_local % len(local_positions):
+            raise ValueError(
+                f"local data axis 1 ({n_local}) must be a multiple of the "
+                f"local position count ({len(local_positions)})")
+        factor = n_local // len(local_positions)
+        global_axis1 = mesh_workers * factor
+        col_of = {}  # global logical worker -> local column
+        for i, w in enumerate(local_positions):
+            for j in range(factor):
+                col_of[w * factor + j] = i * factor + j
+        shape = (x_local.shape[0], global_axis1) + x_local.shape[2:]
+        arrays = []
+        for d, idx in sharding.addressable_devices_indices_map(shape).items():
+            sl = idx[1]  # this device's worker-axis slice
+            lo = sl.start or 0
+            hi = sl.stop if sl.stop is not None else global_axis1
+            try:
+                cols = [col_of[g] for g in range(lo, hi)]
+            except KeyError as e:
+                raise ValueError(
+                    f"Device {d} needs logical worker {e.args[0]} but this "
+                    f"process staged only positions {local_positions}; "
+                    f"host-sharded staging requires each process to provide "
+                    f"all its addressable workers' shards") from None
+            block = x_local[:, cols] if cols != list(
+                range(cols[0], cols[0] + len(cols))) else \
+                x_local[:, cols[0]:cols[0] + len(cols)]
+            arrays.append(jax.device_put(block, d))
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding, arrays)
+
+    return jax.tree.map(put, tree_local)
+
+
 def put_replicated(tree, mesh: Mesh):
     return put_global(tree, replicated(mesh))
 

@@ -183,9 +183,11 @@ def stage_epoch_data(shards, features_col: str, label_col: str,
 def stage_epoch_chunks(shards, features_col: str, label_col: str,
                        batch_size: int, window: int, mesh: Mesh,
                        chunk_rounds: Optional[int] = None,
-                       max_rounds: Optional[int] = None):
-    """Yield ``(device_data, rounds)`` chunks of at most ``chunk_rounds``
-    rounds each, keeping staging memory O(chunk) instead of O(epoch).
+                       max_rounds: Optional[int] = None,
+                       local_positions: Optional[Sequence[int]] = None):
+    """Return a generator of ``(device_data, rounds)`` chunks of at most
+    ``chunk_rounds`` rounds each, keeping staging memory O(chunk) instead
+    of O(epoch).
 
     ``jax.device_put`` is asynchronous, so a caller that dispatches the
     (also asynchronous) epoch computation on chunk *i* and only then pulls
@@ -193,12 +195,39 @@ def stage_epoch_chunks(shards, features_col: str, label_col: str,
     transfer overlapped with device compute — double buffering without any
     explicit machinery. The final chunk may be ragged (one extra XLA
     compilation, amortized across epochs).
+
+    Two multi-process data contracts:
+
+    - ``local_positions=None`` (default, replicated): every process holds
+      the SAME full dataset; ``shards`` covers all logical workers and
+      ``put_global`` carves each process's addressable part.
+    - ``local_positions=[w0, w1, ...]`` (host-sharded): the process stages
+      shards ONLY for its own mesh worker-axis positions (see
+      ``mesh.local_worker_positions``); ``shards`` holds each position's
+      logical workers contiguously, factor per position — this process
+      never materializes (or even holds) other hosts' rows. The common
+      round count is negotiated across processes (a tiny allgather, once
+      per call — eager, not inside the generator, so it runs on the
+      caller's thread in program order on every host).
     """
     per_round = batch_size * window
-    rounds = min(len(s) // per_round for s in shards)
+    local_rounds = min(len(s) // per_round for s in shards)
+    rounds = local_rounds
+    if local_positions is not None and jax.process_count() > 1:
+        # global min: shard sizes may differ across hosts
+        from jax.experimental import multihost_utils
+
+        rounds = int(np.min(multihost_utils.process_allgather(
+            np.int64(rounds))))
     if max_rounds is not None:
         rounds = min(rounds, max_rounds)
     if rounds == 0:
+        if rounds != local_rounds:
+            raise ValueError(
+                f"A PEER process's shards cannot form a single round of "
+                f"window={window} x batch={batch_size} (negotiated global "
+                f"round count is 0; this host's shards of sizes "
+                f"{[len(s) for s in shards]} could form {local_rounds})")
         raise ValueError(
             f"Shards of sizes {[len(s) for s in shards]} cannot form a "
             f"single round of window={window} x batch={batch_size}")
@@ -210,17 +239,26 @@ def stage_epoch_chunks(shards, features_col: str, label_col: str,
     # read from disk in O(chunk) pieces, never materialized whole
     arrs = {key: [s[col] for s in shards] for key, col in cols.items()}
     sharding = mesh_lib.round_major_sharded(mesh)
-    for start in range(0, rounds, chunk_rounds):
-        cnt = min(chunk_rounds, rounds - start)
-        lo = start * per_round
-        hi = lo + cnt * per_round
+    mesh_workers = mesh.shape[WORKERS]
 
-        def stack(key):
-            # round-major: (rounds, workers, window, batch, ...)
-            return np.stack([
-                np.asarray(a[lo:hi]).reshape(
-                    (cnt, window, batch_size) + tuple(a.shape[1:]))
-                for a in arrs[key]], axis=1)
+    def gen():
+        for start in range(0, rounds, chunk_rounds):
+            cnt = min(chunk_rounds, rounds - start)
+            lo = start * per_round
+            hi = lo + cnt * per_round
 
-        data = {key: stack(key) for key in cols}
-        yield mesh_lib.put_global(data, sharding), cnt
+            def stack(key):
+                # round-major: (rounds, workers, window, batch, ...)
+                return np.stack([
+                    np.asarray(a[lo:hi]).reshape(
+                        (cnt, window, batch_size) + tuple(a.shape[1:]))
+                    for a in arrs[key]], axis=1)
+
+            data = {key: stack(key) for key in cols}
+            if local_positions is None:
+                yield mesh_lib.put_global(data, sharding), cnt
+            else:
+                yield mesh_lib.put_host_sharded(
+                    data, sharding, mesh_workers, local_positions), cnt
+
+    return gen()

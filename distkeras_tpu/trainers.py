@@ -210,6 +210,7 @@ class DistributedTrainer(Trainer):
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_folds: Optional[int] = None,
                  staging_rounds: Optional[int] = None,
+                 data_layout: str = "replicated",
                  devices=None,
                  **strategy_kwargs):
         super().__init__(model, loss, worker_optimizer, learning_rate,
@@ -263,6 +264,24 @@ class DistributedTrainer(Trainer):
         # host_async snapshot cadence (commits between snapshots); defaults
         # to one full round of folds (num_workers) when checkpointing is on
         self.checkpoint_folds = checkpoint_folds
+        if data_layout not in ("replicated", "host_sharded"):
+            raise ValueError(
+                f"data_layout must be 'replicated' (every process holds the "
+                f"full dataset) or 'host_sharded' (each process's dataset "
+                f"holds ONLY its own workers' rows), got {data_layout!r}")
+        if data_layout == "host_sharded" and mode == "host_async":
+            raise ValueError(
+                "data_layout='host_sharded' is a multi-process mesh "
+                "contract; host_async workers are threads in one process")
+        # Multi-process input contract. 'replicated': every process holds
+        # the same full dataset and put_global carves its part (simple, but
+        # each host pays full-epoch host RAM + slicing). 'host_sharded':
+        # this process's dataset holds ONLY the rows of its addressable
+        # workers (len = local_workers x per-worker rows), the pod-scale
+        # contract — a Spark executor reading only its partitions. shuffle=
+        # True then shuffles within each host's rows (cross-host shuffling
+        # would need a data exchange the reference also never did).
+        self.data_layout = data_layout
         self.communication_window = int(communication_window)
         # None: stage the whole epoch device-resident (fastest for data that
         # fits). An int bounds staging memory to O(staging_rounds) with
@@ -319,9 +338,23 @@ class DistributedTrainer(Trainer):
                     "(worker threads stage their shards host-resident); "
                     "use mode='sync' for O(chunk) staging")
             return self._train_host_async(dataset, shuffle, resume)
+        from distkeras_tpu.parallel import mesh as mesh_lib
+
         self._start()
-        self._check_trainable(
-            dataset, self.batch_size * self.communication_window * self.num_workers)
+        if self.data_layout == "host_sharded":
+            # this process stages only its own mesh positions' shards
+            positions = mesh_lib.local_worker_positions(self.mesh)
+            n_shards = len(positions) * self.parallelism_factor
+        else:
+            positions, n_shards = None, self.num_workers
+        if positions is None or jax.process_count() == 1:
+            self._check_trainable(
+                dataset,
+                self.batch_size * self.communication_window * n_shards)
+        # else: host_sharded multi-process — a LOCAL raise here would leave
+        # peer processes hanging in the collectives ahead; insufficiency is
+        # detected symmetrically by the rounds allgather in
+        # stage_epoch_chunks (every process sees global min 0 and raises)
         if self.staging_rounds is None:
             self._warn_if_large_resident(dataset, "staging_rounds")
         center, carries = self._setup_state(dataset)
@@ -357,10 +390,11 @@ class DistributedTrainer(Trainer):
                 staged,
                 lambda: substrate.stage_epoch_chunks(
                     (dataset.shuffle(self.seed + epoch)
-                     if shuffle else dataset).repartition(self.num_workers),
+                     if shuffle else dataset).repartition(n_shards),
                     self.features_col, self.label_col, self.batch_size,
                     self.communication_window, self.mesh,
-                    chunk_rounds=self.staging_rounds),
+                    chunk_rounds=self.staging_rounds,
+                    local_positions=positions),
                 resident=not shuffle and self.staging_rounds is None)
             pending = []
             for data, rounds in chunks:

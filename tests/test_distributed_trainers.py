@@ -27,6 +27,33 @@ COMMON = dict(loss="categorical_crossentropy", learning_rate=0.05,
               communication_window=2)
 
 
+def test_host_sharded_layout_matches_replicated_single_process():
+    """data_layout='host_sharded' (each process stages only its own mesh
+    positions' shards via put_host_sharded) degrades to the ordinary path
+    with one process: trajectory and params identical to 'replicated'.
+    The real two-process disjoint-data case is tests/test_multihost.py."""
+    ds = synthetic_mnist(n=512)
+
+    def run(layout):
+        t = ADAG(_model(), **COMMON, data_layout=layout)
+        t.train(ds)
+        return [h["loss"] for h in t.history], t.params
+
+    h_rep, p_rep = run("replicated")
+    h_hs, p_hs = run("host_sharded")
+    assert h_rep == h_hs
+    for a, b in zip(jax.tree.leaves(p_rep), jax.tree.leaves(p_hs)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_host_sharded_layout_validation():
+    with pytest.raises(ValueError, match="data_layout"):
+        ADAG(_model(), num_workers=2, data_layout="bogus")
+    with pytest.raises(ValueError, match="host_async"):
+        ADAG(_model(), num_workers=2, mode="host_async",
+             data_layout="host_sharded")
+
+
 def test_eamsgd_rejects_non_default_worker_optimizer():
     """EAMSGD's local step is the explicit Nesterov rule; a worker_optimizer
     would be silently ignored, so passing one must fail loudly."""
