@@ -68,6 +68,24 @@ def test_mfu_math():
     assert obs.mfu(0, 0.01) is None
 
 
+def test_calibrate_peak_off_tpu_returns_none():
+    """On the CPU mesh there is no peak table entry — calibration must
+    decline rather than fabricate a ratio (bench.py's MFU gate treats None
+    as 'cannot check', not 'ok')."""
+    assert obs.calibrate_peak(size=64, chain=2, repeats=1) is None
+
+
+def test_calibrate_peak_math_with_patched_peak(monkeypatch):
+    """With a fake peak entry the calibration runs end-to-end on CPU and
+    returns a consistent achieved/peak/ratio triple."""
+    monkeypatch.setattr(obs, "device_peak_flops", lambda device=None: 1e12)
+    cal = obs.calibrate_peak(size=64, chain=4, repeats=1)
+    assert set(cal) == {"achieved", "peak", "ratio"}
+    assert cal["peak"] == 1e12
+    assert cal["achieved"] > 0
+    assert cal["ratio"] == cal["achieved"] / cal["peak"]
+
+
 def test_step_timer():
     t = obs.StepTimer()
     with t.measure(4):
