@@ -10,12 +10,18 @@ holding a queue of such specs, executed in order.
 No SSH is implemented (zero-egress environments; launchers own placement
 now) — ``Job.run`` executes locally against the visible devices, which on a
 pod IS the distributed run once ``parallel.distributed.initialize`` has been
-called by the launcher.
+called by the launcher. The reference's submit-and-poll shape is kept:
+``LocalLauncher.submit(bundle_dir)`` launches a saved bundle in a fresh
+interpreter and returns a ``JobHandle`` with the poll/wait/results verbs;
+a remote transport only swaps the process spawn for its own dispatch.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 from typing import Any, Callable, Optional
 
@@ -214,3 +220,101 @@ class Punchcard:
         with open(os.path.join(directory, "ENVIRONMENT.md"), "w") as f:
             f.write(env)
         return directory
+
+
+class JobHandle:
+    """A submitted bundle: poll / wait / fetch results.
+
+    The reference's Job polled a remote head node over TCP for completion;
+    the contract here is the same three verbs against whatever executor the
+    launcher bound (``poll() -> "RUNNING"|"SUCCEEDED"|"FAILED"``,
+    ``wait()``, ``results()``), with the transport behind them swappable.
+    """
+
+    def __init__(self, proc: subprocess.Popen, bundle_dir: str):
+        self._proc = proc
+        self.bundle_dir = bundle_dir
+
+    @property
+    def results_path(self) -> str:
+        return os.path.join(self.bundle_dir, "results.json")
+
+    @property
+    def log_path(self) -> str:
+        return os.path.join(self.bundle_dir, "job.log")
+
+    def poll(self) -> str:
+        rc = self._proc.poll()
+        if rc is None:
+            return "RUNNING"
+        return "SUCCEEDED" if rc == 0 else "FAILED"
+
+    def wait(self, timeout: Optional[float] = None) -> str:
+        """Block until the job finishes (the reference's poll loop, folded
+        into one call); returns the terminal status."""
+        try:
+            self._proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            return "RUNNING"
+        return self.poll()
+
+    def results(self) -> list:
+        """Parsed describe() dicts of every job in the bundle. Raises if the
+        job is still running or failed (with the log tail for diagnosis)."""
+        status = self.poll()
+        if status == "RUNNING":
+            raise RuntimeError("job still running; wait() first")
+        if status == "FAILED":
+            tail = ""
+            if os.path.exists(self.log_path):
+                with open(self.log_path) as f:
+                    tail = f.read()[-2000:]
+            raise RuntimeError(f"job failed (rc={self._proc.returncode}); "
+                               f"log tail:\n{tail}")
+        with open(self.results_path) as f:
+            return json.load(f)
+
+
+class LocalLauncher:
+    """Submit-and-poll executor for saved bundles — the reference's remote
+    job-deployment shape with the transport bound to a local subprocess.
+
+    The reference shipped the job to a head node and polled it; in a
+    zero-egress TPU environment the launcher owns placement, so the honest
+    equivalent executes the bundle's own entry script in a fresh
+    interpreter on THIS host (which, on a pod, is the distributed run once
+    the launcher has every process call ``distributed.initialize``). The
+    submit/poll/results contract is transport-agnostic: a remote backend
+    only swaps ``subprocess.Popen`` for its own dispatch.
+    """
+
+    def __init__(self, python: Optional[str] = None,
+                 env: Optional[dict] = None):
+        self.python = python or sys.executable
+        self.env = env
+
+    def submit(self, bundle_dir: str) -> JobHandle:
+        """Launch ``run_punchcard.py`` detached; results land in
+        ``results.json``, interleaved stdout/stderr in ``job.log``."""
+        entry = os.path.join(bundle_dir, "run_punchcard.py")
+        if not os.path.exists(entry):
+            raise FileNotFoundError(
+                f"{bundle_dir!r} is not a bundle (no run_punchcard.py); "
+                f"create one with Punchcard.save_bundle")
+        env = dict(self.env if self.env is not None else os.environ)
+        # the bundle contract requires distkeras_tpu importable in the
+        # child; fall back to this interpreter's copy AFTER any
+        # caller-supplied PYTHONPATH so an env override (pinned or patched
+        # checkout) wins over the launcher's own package
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (env.get("PYTHONPATH"), pkg_root) if p)
+        log = open(os.path.join(bundle_dir, "job.log"), "w")
+        results = os.path.join(bundle_dir, "results.json")
+        # entry prints results JSON on stdout; capture it into the bundle
+        with open(results, "w") as out:
+            proc = subprocess.Popen(
+                [self.python, entry], stdout=out, stderr=log,
+                env=env, cwd=bundle_dir)
+        log.close()
+        return JobHandle(proc, bundle_dir)

@@ -6,6 +6,7 @@ from distkeras_tpu.models.resnet import (
     resnet18,
     resnet34,
     resnet50,
+    resnet50_nf,
     resnet101,
 )
 from distkeras_tpu.models.vit import ViT, vit_base, vit_large, vit_tiny
@@ -23,6 +24,7 @@ __all__ = [
     "resnet18",
     "resnet34",
     "resnet50",
+    "resnet50_nf",
     "resnet101",
     "vit_base",
     "vit_large",

@@ -16,6 +16,8 @@ from typing import Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
+from distkeras_tpu.models.input_norm import normalize_image_input
+
 
 class CIFARConvNet(nn.Module):
     """Conv stack for 32x32 RGB images (CIFAR-10 shape).
@@ -30,10 +32,13 @@ class CIFARConvNet(nn.Module):
     num_classes: int = 10
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
+    #: uint8 inputs are normalized on device (models/input_norm.py) —
+    #: staging raw bytes is 4x cheaper than f32. No effect on float inputs.
+    normalize_uint8: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = x.astype(self.dtype)
+        x = normalize_image_input(x, self.dtype, self.normalize_uint8)
         if x.ndim == 2:  # flat feature vectors -> NHWC (reference Reshape path)
             side = int(round((x.shape[-1] // 3) ** 0.5))
             x = x.reshape((x.shape[0], side, side, 3))

@@ -108,3 +108,54 @@ def test_job_with_live_model_rejects_bundling():
               batch_size=64)
     with pytest.raises(TypeError, match="dotted"):
         job.to_spec()
+
+
+def test_local_launcher_submit_poll_results(tmp_path):
+    """The submit-and-poll transport (reference job_deployment shape): a
+    saved bundle is launched in a fresh interpreter, polled to completion,
+    and its results fetched — SURVEY §2 item 17's missing verb pair."""
+    import os
+    import sys
+
+    from distkeras_tpu.job_deployment import JobHandle, LocalLauncher
+
+    card = Punchcard(jobs=[Job(
+        "launched-mnist", "SingleTrainer",
+        model="distkeras_tpu.models.mlp:mnist_mlp",
+        data="distkeras_tpu.data.dataset:synthetic_mnist",
+        batch_size=256, num_epoch=1)])
+    bundle = card.save_bundle(str(tmp_path / "bundle"))
+
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}  # keep the child off the TPU
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    handle = LocalLauncher(env=env).submit(bundle)
+    assert handle.poll() in ("RUNNING", "SUCCEEDED")
+    status = handle.wait(timeout=240)
+    assert status == "SUCCEEDED", open(handle.log_path).read()[-2000:]
+    results = handle.results()
+    assert len(results) == 1
+    assert results[0]["job_name"] == "launched-mnist"
+    assert results[0]["training_time"] > 0
+    # results also landed as a file inside the bundle (pollable artifact)
+    assert os.path.exists(handle.results_path)
+
+
+def test_local_launcher_failed_job_surfaces_log(tmp_path):
+    import pytest
+
+    from distkeras_tpu.job_deployment import LocalLauncher
+
+    with pytest.raises(FileNotFoundError, match="bundle"):
+        LocalLauncher().submit(str(tmp_path))  # not a bundle
+
+    # a bundle whose entry dies must report FAILED and carry the log
+    bundle = tmp_path / "bad"
+    bundle.mkdir()
+    (bundle / "run_punchcard.py").write_text(
+        "import sys; print('dying', file=sys.stderr); sys.exit(3)\n")
+    handle = LocalLauncher().submit(str(bundle))
+    assert handle.wait(timeout=60) == "FAILED"
+    with pytest.raises(RuntimeError, match="dying"):
+        handle.results()

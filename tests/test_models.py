@@ -60,3 +60,24 @@ def test_resnet50_param_count():
         jax.random.key(0))
     n = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
     assert 25e6 < n < 26.5e6, n
+
+
+def test_vit_and_cnn_uint8_input_matches_normalized_float():
+    """The on-device uint8 path (VERDICT r3 ask #4: uint8 staging for the
+    ViT/CIFAR configs) equals feeding pre-normalized floats."""
+    import jax
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models import cifar10_cnn, vit_tiny
+
+    rng = np.random.default_rng(5)
+    for model, side in ((vit_tiny(), 16),
+                        (cifar10_cnn(channels=(8, 16), dense_width=32), 32)):
+        u8 = rng.integers(0, 256, (2, side, side, 3), dtype=np.uint8)
+        params = model.init(jax.random.key(0), jnp.asarray(u8),
+                            train=False)["params"]
+        y_u8 = model.apply({"params": params}, jnp.asarray(u8), train=False)
+        xf = (u8.astype(np.float32) - 127.5) / 58.0
+        y_f = model.apply({"params": params}, jnp.asarray(xf), train=False)
+        np.testing.assert_allclose(np.asarray(y_u8), np.asarray(y_f),
+                                   rtol=1e-5, atol=1e-5)

@@ -127,3 +127,14 @@ def test_space_to_depth_stem_shapes_and_grads():
         grads = jax.grad(loss)(params)
         assert all(np.isfinite(np.asarray(g)).all()
                    for g in jax.tree.leaves(grads))
+
+
+def test_resnet50_nf_is_the_bench_recipe():
+    """The public >=50%-MFU constructor (README quickstart / bench.py):
+    norm-free blocks + on-device uint8 normalization, overridable kwargs."""
+    from distkeras_tpu.models import resnet50_nf
+
+    m = resnet50_nf()
+    assert m.norm == "nf" and m.normalize_uint8
+    assert m.stage_sizes == (3, 4, 6, 3)
+    assert resnet50_nf(num_classes=10).num_classes == 10
