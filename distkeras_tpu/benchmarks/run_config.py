@@ -16,12 +16,14 @@ Usage: python -m distkeras_tpu.benchmarks <1-5|all> [--full]
 that works anywhere (CPU mesh included). Output: one JSON line per config
 with samples/sec and, where FLOPs are countable, MFU.
 
-Caveat on this development stack: the tunneled TPU's host→device link
-measures ~45 MB/s (a real TPU host's DMA is GB/s), so these end-to-end
-numbers — which honestly include input staging — are transfer-bound for
-image-scale configs. Each config therefore runs several epochs so the
-once-per-train staging amortizes; the steady-state compute headline is
-repo-root bench.py.
+Caveat on this development stack: the tunneled TPU's host→device link is
+slow AND unstable across days (measured ~45 MB/s in round 3, ~9 MB/s in
+round 4; a real TPU host's DMA is GB/s), so these end-to-end numbers —
+which honestly include input staging — are transfer-bound for image-scale
+configs and only comparable within a measurement session. Image configs
+stage uint8 (models normalize on device) for 4x fewer link bytes. Each
+config runs several epochs so the once-per-train staging amortizes; the
+steady-state compute headline is repo-root bench.py.
 """
 
 import argparse

@@ -173,7 +173,6 @@ class Punchcard:
         ``ENVIRONMENT.md`` (pinned interpreter + dependency versions).
         Returns the directory path.
         """
-        import os
         import platform
         from importlib import metadata
 
@@ -251,7 +250,9 @@ class JobHandle:
 
     def wait(self, timeout: Optional[float] = None) -> str:
         """Block until the job finishes (the reference's poll loop, folded
-        into one call); returns the terminal status."""
+        into one call). Returns the terminal status — or "RUNNING" if
+        ``timeout`` elapsed first (the job is still going; wait again or
+        poll)."""
         try:
             self._proc.wait(timeout=timeout)
         except subprocess.TimeoutExpired:
@@ -267,8 +268,9 @@ class JobHandle:
         if status == "FAILED":
             tail = ""
             if os.path.exists(self.log_path):
-                with open(self.log_path) as f:
-                    tail = f.read()[-2000:]
+                with open(self.log_path, "rb") as f:
+                    f.seek(max(0, os.path.getsize(self.log_path) - 2000))
+                    tail = f.read().decode(errors="replace")
             raise RuntimeError(f"job failed (rc={self._proc.returncode}); "
                                f"log tail:\n{tail}")
         with open(self.results_path) as f:
@@ -309,12 +311,20 @@ class LocalLauncher:
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         env["PYTHONPATH"] = os.pathsep.join(
             p for p in (env.get("PYTHONPATH"), pkg_root) if p)
-        log = open(os.path.join(bundle_dir, "job.log"), "w")
+        # entry prints results JSON on stdout; capture it into the bundle.
+        # Truncate the artifacts only once the spawn succeeds — a bad
+        # interpreter path must not destroy a previous run's results.
         results = os.path.join(bundle_dir, "results.json")
-        # entry prints results JSON on stdout; capture it into the bundle
-        with open(results, "w") as out:
-            proc = subprocess.Popen(
-                [self.python, entry], stdout=out, stderr=log,
-                env=env, cwd=bundle_dir)
-        log.close()
+        with open(results + ".tmp", "w") as out, \
+                open(os.path.join(bundle_dir, "job.log.tmp"), "w") as log:
+            try:
+                proc = subprocess.Popen(
+                    [self.python, entry], stdout=out, stderr=log,
+                    env=env, cwd=bundle_dir)
+            except OSError:
+                os.unlink(out.name)
+                os.unlink(log.name)
+                raise
+        os.replace(out.name, results)
+        os.replace(log.name, os.path.join(bundle_dir, "job.log"))
         return JobHandle(proc, bundle_dir)
