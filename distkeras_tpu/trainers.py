@@ -344,6 +344,12 @@ class DistributedTrainer(Trainer):
         if self.data_layout == "host_sharded":
             # this process stages only its own mesh positions' shards
             positions = mesh_lib.local_worker_positions(self.mesh)
+            if not positions:
+                raise ValueError(
+                    "data_layout='host_sharded' but this process owns no "
+                    "devices on the mesh's workers axis — it has no shards "
+                    "to stage; check the mesh construction (every "
+                    "participating process must contribute worker devices)")
             n_shards = len(positions) * self.parallelism_factor
         else:
             positions, n_shards = None, self.num_workers
@@ -612,7 +618,8 @@ class PjitTrainer(Trainer):
                  model_parallelism: int = 1, partition_rules=None,
                  mesh=None, seed: int = 0,
                  checkpoint_dir: Optional[str] = None,
-                 staging_steps: Optional[int] = None):
+                 staging_steps: Optional[int] = None,
+                 data_layout: str = "replicated"):
         super().__init__(model, loss, worker_optimizer, learning_rate,
                          metrics, features_col, label_col, batch_size,
                          num_epoch, seed, checkpoint_dir=checkpoint_dir)
@@ -625,6 +632,18 @@ class PjitTrainer(Trainer):
         # None: whole epoch device-resident; int: O(staging_steps) chunks
         # with double-buffered device_put (see tensor.stage_step_chunks).
         self.staging_steps = staging_steps
+        if data_layout not in ("replicated", "host_sharded"):
+            raise ValueError(
+                f"data_layout must be 'replicated' or 'host_sharded', "
+                f"got {data_layout!r}")
+        # Multi-process input contract, mirroring DistributedTrainer:
+        # 'replicated' = every process holds the full dataset;
+        # 'host_sharded' = this process's dataset holds ONLY its own
+        # workers' batch rows, consumed as consecutive per-step sub-batches
+        # (global step s = position-ordered concat of every process's rows
+        # [s*local_batch : (s+1)*local_batch)). shuffle=True shuffles
+        # within each host's rows.
+        self.data_layout = data_layout
         if self.batch_size % self.num_workers != 0:
             raise ValueError(
                 f"batch_size {self.batch_size} must be divisible by "
@@ -633,10 +652,40 @@ class PjitTrainer(Trainer):
 
     def train(self, dataset: Dataset, shuffle: bool = False,
               resume: bool = False):
-        from distkeras_tpu.parallel import tensor
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from distkeras_tpu.parallel import mesh as mesh_lib, tensor
 
         self._start()
-        self._check_trainable(dataset, self.batch_size)
+        if self.data_layout == "host_sharded":
+            positions = mesh_lib.local_worker_positions(self.mesh)
+            if not positions:
+                raise ValueError(
+                    "data_layout='host_sharded' but this process owns no "
+                    "devices on the mesh's workers axis — it has no batch "
+                    "rows to stage")
+            local_batch = (self.batch_size // self.num_workers) \
+                * len(positions)
+        else:
+            positions, local_batch = None, self.batch_size
+        max_steps = None
+        if positions is not None and jax.process_count() > 1:
+            # negotiate the common step count (and validate symmetrically:
+            # a one-sided local raise would hang peers in collectives)
+            from jax.experimental import multihost_utils
+
+            step_counts = np.asarray(multihost_utils.process_allgather(
+                np.int64(len(dataset) // local_batch))).ravel()
+            max_steps = int(step_counts.min())
+            if max_steps == 0:
+                short = np.flatnonzero(step_counts == 0).tolist()
+                raise ValueError(
+                    f"Process(es) {short} cannot form one local batch "
+                    f"(per-process step counts {step_counts.tolist()}; "
+                    f"this host is process {jax.process_index()} with "
+                    f"{len(dataset)} rows, local batch {local_batch})")
+        else:
+            self._check_trainable(dataset, local_batch)
         if self.staging_steps is None:
             self._warn_if_large_resident(dataset, "staging_steps")
         state = self._init_params(dataset)
@@ -645,6 +694,14 @@ class PjitTrainer(Trainer):
                 self.model, self.loss, self.tx, self.mesh, self.metrics,
                 self.partition_rules, dropout_seed=self.seed)
         epoch_fn, place_state, place_data = self._pjit_fns
+        if positions is not None:
+            data_sharding = NamedSharding(
+                self.mesh, P(None, mesh_lib.WORKER_AXIS))
+            mesh_workers = self.mesh.shape[mesh_lib.WORKER_AXIS]
+
+            def place_data(data):  # noqa: F811 — host-sharded placement
+                return mesh_lib.put_host_sharded(
+                    data, data_sharding, mesh_workers, positions)
         state = place_state(state)
         ckpt = self._checkpointer()
         snap, start_epoch = self._maybe_resume(
@@ -665,8 +722,8 @@ class PjitTrainer(Trainer):
                              dataset.shuffle(self.seed + epoch)
                              if shuffle else dataset,
                              self.features_col, self.label_col,
-                             self.batch_size,
-                             chunk_steps=self.staging_steps)),
+                             local_batch, chunk_steps=self.staging_steps,
+                             max_steps=max_steps)),
                 resident=not shuffle and self.staging_steps is None)
             pending = []
             for data, steps in chunks:

@@ -75,8 +75,13 @@ def main() -> int:
     procs = [subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--worker", str(pid),
          coordinator], env=env) for pid in (0, 1)]
-    rc = max(p.wait(timeout=600) for p in procs)
-    return rc
+    try:
+        rcs = [p.wait(timeout=600) for p in procs]
+    finally:
+        for p in procs:  # a hung/dead worker must not orphan its sibling
+            if p.poll() is None:
+                p.kill()
+    return 1 if any(rc != 0 for rc in rcs) else 0
 
 
 if __name__ == "__main__":

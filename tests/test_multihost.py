@@ -298,6 +298,84 @@ def test_two_process_host_sharded_disjoint_data_matches_oracle(tmp_path):
     np.testing.assert_allclose(checksum, ref, rtol=1e-5)
 
 
+PJIT_SHARDED_WORKER = textwrap.dedent("""
+    import os, sys
+    pid = int(sys.argv[1]); port = sys.argv[2]; repo = sys.argv[3]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    sys.path.insert(0, repo)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from distkeras_tpu.parallel import distributed
+    distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=2, process_id=pid)
+    import numpy as np
+    from distkeras_tpu import Dataset, PjitTrainer
+    from distkeras_tpu.data import synthetic_mnist
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.parallel.distributed import multihost_mesh
+
+    # host-sharded GSPMD contract: global batch 32 over 8 worker positions
+    # (4 per process); each process holds, per step, ITS positions' 16-row
+    # sub-batch — i.e. the full dataset's rows [s*32+pid*16 : s*32+(pid+1)*16)
+    full = synthetic_mnist(n=512)
+    B, half = 32, 16
+    steps = 512 // B
+    rows = np.concatenate([np.arange(s * B + pid * half,
+                                     s * B + (pid + 1) * half)
+                           for s in range(steps)])
+    ds_local = Dataset({c: np.asarray(full[c])[rows] for c in full.columns})
+
+    t = PjitTrainer(MLP(features=(16,), dropout_rate=0.0),
+                    worker_optimizer="sgd", learning_rate=0.1,
+                    metrics=(), batch_size=B, num_epoch=2,
+                    mesh=multihost_mesh(num_workers=8),
+                    data_layout="host_sharded")
+    t.train(ds_local)
+    losses = [round(h["loss"], 6) for h in t.history]
+    checksum = float(sum(np.abs(np.asarray(l)).sum()
+                         for l in jax.tree.leaves(t.params)))
+    print(f"PJITOK proc={pid} h0={losses[0]} hN={losses[-1]} "
+          f"n={len(losses)} checksum={checksum:.6f}")
+""")
+
+
+def test_two_process_pjit_host_sharded_matches_oracle(tmp_path):
+    """The GSPMD path's host-sharded input contract: two processes each
+    hold only their worker positions' per-step sub-batches; the PjitTrainer
+    trajectory matches the single-process full-dataset oracle."""
+    import re
+
+    outs = _run_two_procs(tmp_path, PJIT_SHARDED_WORKER, timeout=300)
+    vals = {}
+    for out in outs:
+        m = re.search(r"PJITOK proc=(\d) h0=([\d.]+) hN=([\d.]+) n=(\d+) "
+                      r"checksum=([\d.]+)", out)
+        assert m, out[-2000:]
+        vals[m.group(1)] = tuple(float(x) for x in m.groups()[1:])
+    assert vals["0"] == vals["1"]
+
+    import jax
+    import numpy as np
+
+    from distkeras_tpu import PjitTrainer
+    from distkeras_tpu.data.dataset import synthetic_mnist
+    from distkeras_tpu.models.mlp import MLP
+
+    t = PjitTrainer(MLP(features=(16,), dropout_rate=0.0),
+                    worker_optimizer="sgd", learning_rate=0.1,
+                    metrics=(), batch_size=32, num_epoch=2, num_workers=8)
+    t.train(synthetic_mnist(n=512))
+    h0, hN, n, checksum = vals["0"]
+    assert n == len(t.history)
+    np.testing.assert_allclose(h0, t.history[0]["loss"], rtol=1e-4)
+    np.testing.assert_allclose(hN, t.history[-1]["loss"], rtol=1e-4)
+    ref = float(sum(np.abs(np.asarray(l)).sum()
+                    for l in jax.tree.leaves(t.params)))
+    np.testing.assert_allclose(checksum, ref, rtol=1e-5)
+
+
 def test_two_process_full_trainer_matches_single_process(tmp_path):
     """The PUBLIC ADAG trainer — staging, epochs, metric recording, final
     param fetch — runs unchanged on a two-process mesh and reproduces the

@@ -132,3 +132,32 @@ def test_opt_state_sharding_is_structural_not_shape_keyed():
     assert spec_of(mu, "rowp") == P("model", None)
     assert spec_of(nu, "colp") == P(None, "model")
     assert spec_of(nu, "rowp") == P("model", None)
+
+
+def test_pjit_host_sharded_layout_matches_replicated_single_process():
+    """PjitTrainer's data_layout='host_sharded' (each process stages only
+    its own workers' batch rows via put_host_sharded) degrades to the
+    ordinary path on one process: identical trajectory and params. The
+    real two-process disjoint-rows case is tests/test_multihost.py."""
+    ds = synthetic_mnist(n=512)
+    kw = dict(worker_optimizer="sgd", learning_rate=0.1, batch_size=64,
+              num_epoch=2, seed=3, metrics=())
+    model = MLP(features=(32,), num_classes=10, dropout_rate=0.0)
+
+    def run(layout):
+        t = PjitTrainer(model, num_workers=8, data_layout=layout, **kw)
+        t.train(ds)
+        return [h["loss"] for h in t.history], t.params
+
+    h_rep, p_rep = run("replicated")
+    h_hs, p_hs = run("host_sharded")
+    assert h_rep == h_hs
+    for a, b in zip(jax.tree.leaves(p_rep), jax.tree.leaves(p_hs)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pjit_data_layout_validation():
+    import pytest
+
+    with pytest.raises(ValueError, match="data_layout"):
+        PjitTrainer(MLP(features=(8,)), num_workers=2, data_layout="nope")
