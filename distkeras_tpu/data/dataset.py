@@ -271,8 +271,24 @@ class Dataset:
 
     @staticmethod
     def concat(parts: Sequence["Dataset"]) -> "Dataset":
+        """Concatenate datasets row-wise. When any input column is lazy
+        (memmap / ShardedColumn / PermutedColumn) the result column is a
+        ShardedColumn over the parts — no bytes are read; in-memory inputs
+        concatenate eagerly as before."""
         cols = parts[0].columns
-        return Dataset({c: np.concatenate([p[c] for p in parts]) for c in cols})
+        out: Dict[str, ColumnLike] = {}
+        for c in cols:
+            vs = [p[c] for p in parts]
+            lazy = any(isinstance(
+                v, (ShardedColumn, np.memmap, PermutedColumn)) for v in vs)
+            # mixed dtypes fall back to eager concatenation, which PROMOTES
+            # (f32 + f64 -> f64) the way plain np.concatenate always did;
+            # the lazy view requires one common dtype
+            if lazy and len({np.dtype(v.dtype) for v in vs}) == 1:
+                out[c] = vs[0] if len(vs) == 1 else ShardedColumn(vs)
+            else:
+                out[c] = np.concatenate([np.asarray(v) for v in vs])
+        return Dataset(out)
 
 
 def synthetic_mnist(n: int = 4096, seed: int = 0,

@@ -162,6 +162,8 @@ class HostAsyncRunner:
             except Exception as e:  # surface save failures to the caller
                 errors.append(e)
 
+        abort = threading.Event()
+
         def worker(k: int):
             try:
                 dev = self.worker_devices[k]
@@ -170,6 +172,8 @@ class HostAsyncRunner:
                 fold = 0
                 for shards in epoch_shards:
                     for rnd, batches in enumerate(shards[k]):
+                        if abort.is_set():
+                            return  # a sibling died: stop wasting windows
                         center, clock = ps.pull()
                         carry, commit, ms = self.window_fn(
                             carry, jax.device_put(center, dev),
@@ -189,6 +193,9 @@ class HostAsyncRunner:
                         fold += 1
             except Exception as e:  # surface thread failures to the caller
                 errors.append(e)
+                abort.set()  # fail fast: siblings stop at their next round
+                             # (the reference analogue: Spark killing the
+                             # job when a task fails terminally)
 
         checkpointing = checkpointer is not None and checkpoint_folds > 0
         saver_thread = None

@@ -240,3 +240,27 @@ def test_device_get_batched_chunks_many_leaves():
     host = fetch.device_get_batched(tree)
     for i, h in enumerate(host):
         np.testing.assert_array_equal(h, np.full((2,), float(i)))
+
+
+def test_concat_of_lazy_datasets_stays_lazy(shard_files):
+    """Dataset.concat over file-backed (or shuffled-lazy) parts must not
+    read the files — the result presents a ShardedColumn view."""
+    ds, paths = shard_files
+    fds = Dataset.from_files(paths)
+    halves = fds.repartition(2)
+    cat = Dataset.concat(halves)
+    assert isinstance(cat["features"], ShardedColumn)
+    np.testing.assert_array_equal(np.asarray(cat["features"]),
+                                  np.asarray(ds["features"]))
+    # mixed lazy + shuffled-lazy parts also stay lazy and read O(slice)
+    cat2 = Dataset.concat([halves[0], halves[1].shuffle(3)])
+    assert isinstance(cat2["features"], ShardedColumn)
+    np.testing.assert_array_equal(np.asarray(cat2["features"][250:270]),
+                                  np.concatenate([
+                                      np.asarray(halves[0]["features"]),
+                                      np.asarray(
+                                          halves[1].shuffle(3)["features"]),
+                                  ])[250:270])
+    # eager inputs still concatenate eagerly
+    mem = Dataset.concat([ds.take(8), ds.take(8)])
+    assert isinstance(mem["features"], np.ndarray)

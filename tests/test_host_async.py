@@ -196,6 +196,55 @@ def test_host_async_checkpoint_kill_and_resume(tmp_path, monkeypatch):
     assert Checkpointer(str(tmp_path / "ck")).latest_step() == t2.num_updates
 
 
+def test_host_async_sibling_failure_aborts_fast(monkeypatch):
+    """One worker dying terminally stops the whole run promptly (the
+    reference analogue: Spark kills the job on terminal task failure) —
+    siblings check an abort flag at round boundaries instead of finishing
+    their full data pass against a dead run."""
+    from distkeras_tpu import ADAG
+    from distkeras_tpu.parallel import host_async
+
+    import threading
+
+    class Bomb(Exception):
+        pass
+
+    attempts = []
+    bomber = []  # thread id of the ONE worker that dies
+    real_server_for = host_async.server_for
+
+    def bombed(strategy, params):
+        ps = real_server_for(strategy, params)
+        orig = ps.commit
+
+        def commit(delta, last_update=0):
+            attempts.append(1)
+            tid = threading.get_ident()
+            if ps.num_updates >= 3 and not bomber:
+                bomber.append(tid)
+            if bomber and bomber[0] == tid:
+                raise Bomb("worker down")
+            # every OTHER worker keeps committing normally — it can only
+            # stop early via the abort flag, which is what's under test
+            return orig(delta, last_update=last_update)
+
+        ps.commit = commit
+        return ps
+
+    monkeypatch.setattr(host_async, "server_for", bombed)
+    workers = 4
+    t = ADAG(_model(), mode="host_async", num_workers=workers,
+             worker_optimizer="sgd", learning_rate=0.05, metrics=(),
+             batch_size=8, communication_window=2, num_epoch=4)
+    with pytest.raises(Bomb):
+        t.train(synthetic_mnist(n=2048))
+    # without the abort the 3 surviving workers would run their full data
+    # passes (32 rounds x 4 epochs each => ~390 commit attempts); with it
+    # each stops at its next round boundary after the bomb — a handful of
+    # in-flight attempts at most
+    assert len(attempts) <= 24, len(attempts)
+
+
 def test_sync_mode_rejects_devices_kwarg():
     import pytest
 

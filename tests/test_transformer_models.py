@@ -107,3 +107,37 @@ def test_vit_tiny_forward_and_grad():
     grads = jax.grad(loss)(params)
     assert all(np.isfinite(float(jnp.linalg.norm(g)))
                for g in jax.tree.leaves(grads))
+
+
+def test_bert_int16_staging_matches_int32():
+    """int16 token staging (config 4's transfer lever — the text analogue
+    of uint8 image staging): model forward, masked_lm loss, and masked
+    accuracy are identical to int32 inputs; -1 ignore labels survive."""
+    import jax
+
+    from distkeras_tpu import engine
+    from distkeras_tpu.models import bert_tiny
+    from distkeras_tpu.ops import losses as losses_lib
+
+    model = bert_tiny()
+    rng = np.random.default_rng(3)
+    ids32 = rng.integers(1, model.vocab_size, (2, 16)).astype(np.int32)
+    labels32 = np.where(rng.random((2, 16)) < 0.3, ids32, -1).astype(np.int32)
+    params = model.init(jax.random.key(0), jnp.asarray(ids32),
+                        train=False)["params"]
+
+    def forward(ids):
+        return model.apply({"params": params}, jnp.asarray(ids), train=False)
+
+    out32, out16 = forward(ids32), forward(ids32.astype(np.int16))
+    np.testing.assert_array_equal(np.asarray(out32), np.asarray(out16))
+    loss = losses_lib.get("masked_lm")
+    np.testing.assert_array_equal(
+        np.asarray(loss(out32, jnp.asarray(labels32))),
+        np.asarray(loss(out16, jnp.asarray(labels32.astype(np.int16)))))
+    np.testing.assert_array_equal(
+        np.asarray(engine.compute_metric("masked_accuracy", out32,
+                                         jnp.asarray(labels32))),
+        np.asarray(engine.compute_metric("masked_accuracy", out16,
+                                         jnp.asarray(
+                                             labels32.astype(np.int16)))))
