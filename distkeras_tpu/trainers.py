@@ -194,6 +194,10 @@ class DistributedTrainer(Trainer):
 
     ``strategy_name`` selects the update algebra (see
     parallel/strategies.py + NUMERICS.md).
+
+    Multi-process input contract: ``data_layout="replicated"`` (default —
+    every process holds the full dataset) or ``"host_sharded"`` (each
+    process's dataset holds only its own workers' rows; see DESIGN.md §3).
     """
 
     strategy_name: str = "downpour"
