@@ -21,14 +21,14 @@ from distkeras_tpu.trainers import DOWNPOUR, SingleTrainer
 from distkeras_tpu.utils.trees import tree_sub, tree_zeros_like
 
 
-def _tiny_setup(lr=0.05):
-    model = MLP(features=(16,), num_classes=4)
-    rng = np.random.default_rng(0)
-    x = rng.standard_normal((8, 12)).astype(np.float32)
-    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+def _tiny_setup(lr=0.05, width=16, classes=4, feat=12, batch_n=8, seed=0):
+    model = MLP(features=(width,), num_classes=classes)
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((batch_n, feat)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, batch_n)]
     batch = {"features": x, "labels": y}
     tx = optax.sgd(lr)
-    state = engine.create_train_state(model, jax.random.key(0), batch, tx)
+    state = engine.create_train_state(model, jax.random.key(seed), batch, tx)
     grad_fn = engine.make_grad_fn(model, "categorical_crossentropy")
     return model, tx, state, grad_fn, batch
 
@@ -151,3 +151,78 @@ def test_dynsgd_ps_staleness_scaling():
     np.testing.assert_allclose(float(center["w"]), 2.5)
     with pytest.raises(ValueError):
         ps.commit({"w": jnp.ones(())}, last_update=99)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_property_randomized_strategy_invariants(seed):
+    """Randomized (seeded) property sweep over the update algebra — the
+    SURVEY §5 'property tests' story. For random shapes, data, learning
+    rates and windows, the NUMERICS.md relations must hold:
+
+      P1  ADAG commit == DOWNPOUR commit / window (same trajectory)
+      P2  EAMSGD with mu=0 == AEASGD (same rho/eta) after a full round
+      P3  center conservation: after one sequential PS round,
+          center' - center == sum of the (weighted) commits
+      P4  DynSGD commit at staleness 0 folds exactly like DOWNPOUR's
+    """
+    rng = np.random.default_rng(100 + seed)
+    width = int(rng.integers(4, 24))
+    classes = int(rng.integers(2, 6))
+    feat = int(rng.integers(3, 17))
+    batch_n = int(rng.integers(2, 9))
+    window = int(rng.integers(1, 6))
+    lr = float(rng.uniform(0.005, 0.2))
+    rho = float(rng.uniform(0.1, 3.0))
+
+    model, tx, state, grad_fn, _ = _tiny_setup(
+        lr=lr, width=width, classes=classes, feat=feat, batch_n=batch_n,
+        seed=seed)
+    x = rng.standard_normal((window, batch_n, feat)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[
+        rng.integers(0, classes, (window, batch_n))]
+    batches = [{"features": x[i], "labels": y[i]} for i in range(window)]
+    center = state.params
+
+    def run_round(strategy):
+        carry = strategy.init_carry(center, tx)
+        carry = strategy.round_start(carry, center)
+        for b in batches:
+            carry, _ = strategy.local_step(grad_fn, tx, carry, b)
+        return strategy.commit(carry, center, window)
+
+    # P1: ADAG == DOWNPOUR / window, leaf for leaf
+    c_dp = run_round(strategies.get("downpour"))
+    c_adag = run_round(strategies.get("adag"))
+    for a, d in zip(jax.tree.leaves(c_adag), jax.tree.leaves(c_dp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(d) / window,
+                                   rtol=1e-5, atol=1e-7)
+
+    # P2: EAMSGD(mu=0) == AEASGD for the same rho/eta. Their local steps
+    # differ in form (explicit Nesterov vs optax sgd) but coincide at mu=0.
+    c_ae = run_round(strategies.get("aeasgd", rho=rho, learning_rate=lr))
+    c_eam = run_round(strategies.get("eamsgd", rho=rho, learning_rate=lr,
+                                     momentum=0.0))
+    for a, e in zip(jax.tree.leaves(c_ae), jax.tree.leaves(c_eam)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=1e-4, atol=1e-6)
+
+    # P3 + P4: sequential PS folds conserve the commit sum
+    ps = DeltaParameterServer(center)
+    before, clock0 = ps.pull()
+    ps.commit(c_dp, last_update=clock0)
+    ps.commit(c_adag, last_update=clock0)
+    after, _ = ps.pull()
+    for b, a, d1, d2 in zip(jax.tree.leaves(before), jax.tree.leaves(after),
+                            jax.tree.leaves(c_dp), jax.tree.leaves(c_adag)):
+        np.testing.assert_allclose(
+            np.asarray(a) - np.asarray(b),
+            np.asarray(d1) + np.asarray(d2), rtol=1e-5, atol=1e-6)
+
+    dyn = DynSGDParameterServer(center)
+    _, clk = dyn.pull()
+    dyn.commit(c_dp, last_update=clk)  # staleness 0 -> weight 1
+    after_dyn, _ = dyn.pull()
+    for b, a, d in zip(jax.tree.leaves(center), jax.tree.leaves(after_dyn),
+                       jax.tree.leaves(c_dp)):
+        np.testing.assert_allclose(np.asarray(a) - np.asarray(b),
+                                   np.asarray(d), rtol=1e-5, atol=1e-6)
