@@ -45,9 +45,28 @@ class Trainer:
                  metrics: Sequence[str] = ("accuracy",),
                  features_col: str = "features", label_col: str = "label",
                  batch_size: int = 32, num_epoch: int = 1, seed: int = 0,
+                 loss_weights=None,
                  checkpoint_dir: Optional[str] = None):
         self.model = model
         self.loss = loss
+        base_loss = losses_lib.get(loss)  # fail fast on unknown loss names
+        # Reference Trainer holds loss_weights (Keras multi-output scaling).
+        # The zoo is single-output, so the honest subset: one scalar weight
+        # scaling the loss (gradients scale with it). Anything that isn't a
+        # single number (multi-weight lists/arrays, Keras output-name dicts)
+        # is rejected loudly rather than silently dropped.
+        if loss_weights is not None:
+            ws = list(np.ravel(loss_weights)) \
+                if isinstance(loss_weights, (list, tuple, np.ndarray)) \
+                else [loss_weights]
+            if len(ws) != 1 or not isinstance(ws[0], (int, float, np.number)):
+                raise ValueError(
+                    f"loss_weights={loss_weights!r}: models here are "
+                    f"single-output, so exactly ONE numeric weight is "
+                    f"meaningful (a scalar or one-element list)")
+            w = float(ws[0])
+            self.loss = lambda logits, labels: w * base_loss(logits, labels)
+        self.loss_weights = loss_weights
         self.worker_optimizer = worker_optimizer
         self.learning_rate = learning_rate
         self.metrics = tuple(metrics)
@@ -59,7 +78,6 @@ class Trainer:
         self.checkpoint_dir = checkpoint_dir
 
         self.tx = opt_lib.get(worker_optimizer, learning_rate)
-        losses_lib.get(loss)  # fail fast on unknown loss names
         self.params = None
         self.history: list[dict] = []
         self.training_time: float = 0.0
@@ -211,6 +229,7 @@ class DistributedTrainer(Trainer):
                  parallelism_factor: int = 1,
                  master_port: Optional[int] = None,  # parity no-op
                  mesh=None, seed: int = 0, mode: str = "sync",
+                 loss_weights=None,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_folds: Optional[int] = None,
                  staging_rounds: Optional[int] = None,
@@ -219,7 +238,8 @@ class DistributedTrainer(Trainer):
                  **strategy_kwargs):
         super().__init__(model, loss, worker_optimizer, learning_rate,
                          metrics, features_col, label_col, batch_size,
-                         num_epoch, seed, checkpoint_dir=checkpoint_dir)
+                         num_epoch, seed, loss_weights=loss_weights,
+                         checkpoint_dir=checkpoint_dir)
         from distkeras_tpu.parallel import mesh as mesh_lib
 
         if mode not in ("sync", "host_async"):
@@ -620,13 +640,14 @@ class PjitTrainer(Trainer):
                  label_col="label", batch_size: int = 32, num_epoch: int = 1,
                  num_workers: Optional[int] = None,
                  model_parallelism: int = 1, partition_rules=None,
-                 mesh=None, seed: int = 0,
+                 mesh=None, seed: int = 0, loss_weights=None,
                  checkpoint_dir: Optional[str] = None,
                  staging_steps: Optional[int] = None,
                  data_layout: str = "replicated"):
         super().__init__(model, loss, worker_optimizer, learning_rate,
                          metrics, features_col, label_col, batch_size,
-                         num_epoch, seed, checkpoint_dir=checkpoint_dir)
+                         num_epoch, seed, loss_weights=loss_weights,
+                         checkpoint_dir=checkpoint_dir)
         from distkeras_tpu.parallel import mesh as mesh_lib
 
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(

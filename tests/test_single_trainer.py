@@ -66,3 +66,35 @@ def test_single_trainer_staging_steps_chunked_equals_resident():
 
     for a, b in zip(jax.tree.leaves(params_res), jax.tree.leaves(params_chk)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_loss_weights_scale_loss_and_gradients():
+    """Reference-parity loss_weights kwarg (single-output subset): a scalar
+    weight scales the recorded loss and, at weight 2 with half the learning
+    rate, reproduces the unweighted trajectory exactly (SGD linearity)."""
+    import jax
+    import pytest
+
+    from distkeras_tpu import SingleTrainer
+    from distkeras_tpu.data.dataset import synthetic_mnist
+    from distkeras_tpu.models import MLP
+
+    ds = synthetic_mnist(n=256)
+
+    def run(lw, lr):
+        t = SingleTrainer(MLP(features=(16,), dropout_rate=0.0),
+                          worker_optimizer="sgd", learning_rate=lr,
+                          batch_size=32, num_epoch=1, metrics=(),
+                          loss_weights=lw, seed=1)
+        t.train(ds)
+        return t.history, t.params
+
+    h1, p1 = run(None, 0.1)
+    h2, p2 = run([2.0], 0.05)
+    np.testing.assert_allclose([h["loss"] for h in h2],
+                               [2 * h["loss"] for h in h1], rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    with pytest.raises(ValueError, match="loss_weights"):
+        SingleTrainer(MLP(features=(8,)), loss_weights=[1.0, 2.0])
