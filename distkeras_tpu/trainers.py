@@ -59,7 +59,8 @@ class Trainer:
             ws = list(np.ravel(loss_weights)) \
                 if isinstance(loss_weights, (list, tuple, np.ndarray)) \
                 else [loss_weights]
-            if len(ws) != 1 or not isinstance(ws[0], (int, float, np.number)):
+            if len(ws) != 1 or isinstance(ws[0], bool) or \
+                    not isinstance(ws[0], (int, float, np.number)):
                 raise ValueError(
                     f"loss_weights={loss_weights!r}: models here are "
                     f"single-output, so exactly ONE numeric weight is "
