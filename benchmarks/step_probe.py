@@ -1,0 +1,123 @@
+"""Bare train-step MFU probe — chip-side ground truth per model.
+
+The end-to-end config numbers (distkeras-tpu-bench) honestly include input
+staging, which on this development stack rides a MB/s-grade tunnel whose
+rate swings between runs; even the staging-cancelled ``--marginal`` mode is
+only reliable when per-epoch compute exceeds the link's staging variance.
+This probe is the other bound: ONE jitted scan of train steps on
+device-resident data — no staging in the timed window at all — giving the
+compute ceiling the trainer harness should approach on a real TPU host.
+
+Usage: python benchmarks/step_probe.py [vit|resnet|bert|all] [--batch N]
+Prints one JSON line per model with samples/s and MFU (fetch-synced timing,
+analytic FLOPs — same methodology as bench.py, validated by
+observability.calibrate_peak).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+try:
+    import distkeras_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # running from a source checkout: use the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def probe(name: str, batch: int, steps: int = 8) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distkeras_tpu import engine, observability
+
+    if name == "vit":
+        from distkeras_tpu.models import vit_base
+
+        model, loss = vit_base(), "categorical_crossentropy"
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 256, (batch, 224, 224, 3), dtype=np.uint8)
+        y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]
+    elif name == "resnet":
+        from distkeras_tpu.models import resnet50_nf
+
+        model, loss = resnet50_nf(), "categorical_crossentropy"
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 256, (batch, 224, 224, 3), dtype=np.uint8)
+        y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]
+    elif name == "bert":
+        from distkeras_tpu.models import bert_base
+
+        model, loss = bert_base(), "masked_lm"
+        rng = np.random.default_rng(0)
+        x = rng.integers(1, model.vocab_size, (batch, 128)).astype(np.int16)
+        y = np.where(rng.random((batch, 128)) < 0.15, x, -1).astype(np.int16)
+    else:
+        raise ValueError(f"unknown model {name!r}")
+
+    tx = optax.adamw(1e-3)
+    grad_fn = engine.make_grad_fn(model, loss)
+    xd, yd = jnp.asarray(x), jnp.asarray(y)
+    state = engine.create_train_state(model, jax.random.key(0),
+                                      {"features": xd}, tx)
+
+    @jax.jit
+    def run(params, opt_state, x, y):
+        def one(c, _):
+            p, o = c
+            (l, _), g = grad_fn(p, {"features": x, "labels": y}, None)
+            up, o = tx.update(g, o, p)
+            return (optax.apply_updates(p, up), o), l
+
+        (p, o), ls = jax.lax.scan(one, (params, opt_state), None,
+                                  length=steps)
+        return p, o, jnp.sum(ls)
+
+    flops = observability.count_flops(
+        lambda p, b: grad_fn(p, b, None)[1], state.params,
+        {"features": xd, "labels": yd}) * steps
+    p, o, s = run(state.params, state.opt_state, xd, yd)
+    float(np.asarray(s))  # compile + settle (fetch = completion barrier)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        p, o, s = run(p, o, xd, yd)
+        float(np.asarray(s))
+        times.append(time.perf_counter() - t0)
+    dt = sorted(times)[1]
+    out = {"model": name, "batch": batch, "steps_per_call": steps,
+           "samples_per_sec": round(batch * steps / dt, 1)}
+    peak = observability.device_peak_flops()
+    if peak:
+        out["mfu"] = round(flops / dt / peak, 4)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("which", nargs="?", default="all",
+                    choices=["vit", "resnet", "bert", "all"])
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=24,
+                    help="scanned steps per timed device call; keep the "
+                         "call >=1s so the ~90ms tunnel dispatch is noise")
+    args = ap.parse_args()
+    names = ["vit", "resnet", "bert"] if args.which == "all" else [args.which]
+    for name in names:
+        try:
+            print(json.dumps(probe(name, args.batch, steps=args.steps)))
+        except Exception as e:
+            print(json.dumps({"model": name,
+                              "error": f"{type(e).__name__}: {e}"}))
+            sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
