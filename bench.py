@@ -135,8 +135,10 @@ def main():
         # window-normalized so the server step is λ-invariant) halves the
         # center-fold count vs window=8. Measured r4 sweep at 384 steps:
         # w8 r48 54.67%, w16 r24 54.80% MFU (w8 r24 = 192 steps: 54.43%).
-        # uint8 staging keeps the 24-round chunk at ~3.7 GB HBM. The
-        # fallback config is deliberately small (OOM headroom).
+        # uint8 staging keeps the 384-step chunk at ~7.4 GB HBM (staged
+        # bytes depend on rounds x window x batch, unchanged by the w16
+        # re-split). The fallback config is deliberately small (OOM
+        # headroom).
         configs = [dict(batch_size=128, image_side=224, window=16, rounds=24,
                         num_classes=1000, tiny=False),
                    dict(batch_size=64, image_side=224, window=8, rounds=24,

@@ -119,3 +119,35 @@ def test_host_async_rejects_staging_rounds():
                  staging_rounds=4)
     with pytest.raises(ValueError, match="staging_rounds"):
         t.train(synthetic_mnist(n=256))
+
+
+def test_resume_with_streaming_shuffle_from_disk(tmp_path):
+    """Three round-4 features interacting: checkpoint-resume x streaming
+    shuffle x file-backed chunked staging. A run killed after 2 of 4 epochs
+    and resumed from disk data with shuffle=True reproduces the
+    uninterrupted 4-epoch run bit for bit (per-epoch shuffle seeds are
+    seed+epoch, so the resumed epochs redraw the same lazy permutations)."""
+    from distkeras_tpu.data import Dataset
+
+    ds = synthetic_mnist(n=512)
+    paths = {}
+    for col in ("features", "label"):
+        p = tmp_path / f"{col}.npy"
+        np.save(p, np.asarray(ds[col]))
+        paths[col] = str(p)
+    fds = Dataset.from_files(paths)
+    kw = dict(worker_optimizer="sgd", learning_rate=0.05, metrics=(),
+              num_workers=4, batch_size=8, communication_window=2,
+              staging_rounds=2, seed=3)
+
+    full = ADAG(_model(), num_epoch=4, **kw)
+    p_full = full.train(fds, shuffle=True)
+
+    first = ADAG(_model(), num_epoch=2,
+                 checkpoint_dir=str(tmp_path / "ck"), **kw)
+    first.train(fds, shuffle=True)
+    second = ADAG(_model(), num_epoch=4,
+                  checkpoint_dir=str(tmp_path / "ck"), **kw)
+    p_resumed = second.train(fds, shuffle=True, resume=True)
+    _params_equal(p_full, p_resumed)
+    assert len(second.get_history()) == len(full.get_history()) // 2
