@@ -84,6 +84,13 @@ def _time_trainer(trainer, ds, marginal: bool = False):
     number a real TPU host (GB/s DMA, not this stack's MB/s tunnel) would
     see end to end. Reported as ``marginal_*`` next to the honest
     end-to-end figures.
+
+    Side effect of ``marginal=True``: the extra 2E-epoch timing run leaves
+    ``trainer.history``/``params``/``training_time`` reflecting THAT run.
+    The REPORTED figures (final_loss, steps, wall, samples/sec, mfu) are
+    all captured from the timed E-epoch run before the rerun, so the flag
+    doesn't change what is reported; the trainers are bench-local and
+    discarded, so the stale object state is not snapshot/restored.
     """
     from distkeras_tpu import observability
 

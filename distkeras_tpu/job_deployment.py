@@ -233,6 +233,7 @@ class JobHandle:
     def __init__(self, proc: subprocess.Popen, bundle_dir: str):
         self._proc = proc
         self.bundle_dir = bundle_dir
+        self._finalized = False
 
     @property
     def results_path(self) -> str:
@@ -242,11 +243,37 @@ class JobHandle:
     def log_path(self) -> str:
         return os.path.join(self.bundle_dir, "job.log")
 
+    def _finalize(self, status: str) -> None:
+        """Promote the child's ``.tmp`` artifacts at terminal status.
+        ``results.json`` is replaced only on SUCCESS — a job that launched
+        but then failed must not destroy a previous run's results (the
+        failed run's partial stdout is discarded). ``job.log`` is promoted
+        either way: the failure tail lives there.
+
+        Promotion happens on the submitter's first ``poll()``/``wait()``
+        after the job ends (results()/wait() both route through poll) —
+        until then ``results.json`` still holds the PREVIOUS run. External
+        readers should watch the handle, not the bare file."""
+        if self._finalized:
+            return
+        log_tmp = self.log_path + ".tmp"
+        if os.path.exists(log_tmp):
+            os.replace(log_tmp, self.log_path)
+        res_tmp = self.results_path + ".tmp"
+        if status == "SUCCEEDED":
+            if os.path.exists(res_tmp):
+                os.replace(res_tmp, self.results_path)
+        elif os.path.exists(res_tmp):
+            os.unlink(res_tmp)
+        self._finalized = True  # only after promotion fully succeeded
+
     def poll(self) -> str:
         rc = self._proc.poll()
         if rc is None:
             return "RUNNING"
-        return "SUCCEEDED" if rc == 0 else "FAILED"
+        status = "SUCCEEDED" if rc == 0 else "FAILED"
+        self._finalize(status)
+        return status
 
     def wait(self, timeout: Optional[float] = None) -> str:
         """Block until the job finishes (the reference's poll loop, folded
@@ -312,8 +339,10 @@ class LocalLauncher:
         env["PYTHONPATH"] = os.pathsep.join(
             p for p in (env.get("PYTHONPATH"), pkg_root) if p)
         # entry prints results JSON on stdout; capture it into the bundle.
-        # Truncate the artifacts only once the spawn succeeds — a bad
-        # interpreter path must not destroy a previous run's results.
+        # The child writes to .tmp paths for its whole life; JobHandle
+        # promotes them at terminal status (results.json only on success) —
+        # neither a bad interpreter path NOR a job that launches and then
+        # fails can destroy a previous run's results.
         results = os.path.join(bundle_dir, "results.json")
         with open(results + ".tmp", "w") as out, \
                 open(os.path.join(bundle_dir, "job.log.tmp"), "w") as log:
@@ -325,6 +354,4 @@ class LocalLauncher:
                 os.unlink(out.name)
                 os.unlink(log.name)
                 raise
-        os.replace(out.name, results)
-        os.replace(log.name, os.path.join(bundle_dir, "job.log"))
         return JobHandle(proc, bundle_dir)

@@ -142,6 +142,12 @@ def put_host_sharded(tree_local, sharding: NamedSharding,
                     f"process staged only positions {local_positions}; "
                     f"host-sharded staging requires each process to provide "
                     f"all its addressable workers' shards") from None
+            if not cols:
+                raise ValueError(
+                    f"Device {d} has an EMPTY worker-axis slice {sl!r} under "
+                    f"sharding {sharding} (global axis {global_axis1}); a "
+                    f"degenerate sharding that assigns a device no workers "
+                    f"cannot be host-staged")
             block = x_local[:, cols] if cols != list(
                 range(cols[0], cols[0] + len(cols))) else \
                 x_local[:, cols[0]:cols[0] + len(cols)]
