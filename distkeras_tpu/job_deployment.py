@@ -23,6 +23,7 @@ import os
 import subprocess
 import sys
 import time
+import uuid
 from typing import Any, Callable, Optional
 
 from distkeras_tpu.data.dataset import Dataset
@@ -230,9 +231,16 @@ class JobHandle:
     ``wait()``, ``results()``), with the transport behind them swappable.
     """
 
-    def __init__(self, proc: subprocess.Popen, bundle_dir: str):
+    def __init__(self, proc: subprocess.Popen, bundle_dir: str,
+                 results_tmp: Optional[str] = None,
+                 log_tmp: Optional[str] = None):
         self._proc = proc
         self.bundle_dir = bundle_dir
+        # per-submission tmp paths: unique per child, so re-submitting the
+        # same bundle while a prior job still runs can't interleave two
+        # children's writes into one inode
+        self._results_tmp = results_tmp or self.results_path + ".tmp"
+        self._log_tmp = log_tmp or self.log_path + ".tmp"
         self._finalized = False
 
     @property
@@ -256,15 +264,13 @@ class JobHandle:
         readers should watch the handle, not the bare file."""
         if self._finalized:
             return
-        log_tmp = self.log_path + ".tmp"
-        if os.path.exists(log_tmp):
-            os.replace(log_tmp, self.log_path)
-        res_tmp = self.results_path + ".tmp"
+        if os.path.exists(self._log_tmp):
+            os.replace(self._log_tmp, self.log_path)
         if status == "SUCCEEDED":
-            if os.path.exists(res_tmp):
-                os.replace(res_tmp, self.results_path)
-        elif os.path.exists(res_tmp):
-            os.unlink(res_tmp)
+            if os.path.exists(self._results_tmp):
+                os.replace(self._results_tmp, self.results_path)
+        elif os.path.exists(self._results_tmp):
+            os.unlink(self._results_tmp)
         self._finalized = True  # only after promotion fully succeeded
 
     def poll(self) -> str:
@@ -339,13 +345,15 @@ class LocalLauncher:
         env["PYTHONPATH"] = os.pathsep.join(
             p for p in (env.get("PYTHONPATH"), pkg_root) if p)
         # entry prints results JSON on stdout; capture it into the bundle.
-        # The child writes to .tmp paths for its whole life; JobHandle
-        # promotes them at terminal status (results.json only on success) —
-        # neither a bad interpreter path NOR a job that launches and then
-        # fails can destroy a previous run's results.
-        results = os.path.join(bundle_dir, "results.json")
-        with open(results + ".tmp", "w") as out, \
-                open(os.path.join(bundle_dir, "job.log.tmp"), "w") as log:
+        # The child writes to UNIQUELY-NAMED .tmp paths for its whole life
+        # (uuid suffix: two submits of one bundle never share an inode);
+        # JobHandle promotes them at terminal status (results.json only on
+        # success) — neither a bad interpreter path NOR a job that launches
+        # and then fails can destroy a previous run's results.
+        suffix = ".tmp." + uuid.uuid4().hex[:8]
+        results_tmp = os.path.join(bundle_dir, "results.json" + suffix)
+        log_tmp = os.path.join(bundle_dir, "job.log" + suffix)
+        with open(results_tmp, "w") as out, open(log_tmp, "w") as log:
             try:
                 proc = subprocess.Popen(
                     [self.python, entry], stdout=out, stderr=log,
@@ -354,4 +362,4 @@ class LocalLauncher:
                 os.unlink(out.name)
                 os.unlink(log.name)
                 raise
-        return JobHandle(proc, bundle_dir)
+        return JobHandle(proc, bundle_dir, results_tmp, log_tmp)

@@ -133,7 +133,10 @@ def test_local_launcher_submit_poll_results(tmp_path):
     handle = LocalLauncher(env=env).submit(bundle)
     assert handle.poll() in ("RUNNING", "SUCCEEDED")
     status = handle.wait(timeout=240)
-    assert status == "SUCCEEDED", open(handle.log_path).read()[-2000:]
+    # diagnostic: before terminal finalize the log is still at its .tmp path
+    log = handle.log_path if os.path.exists(handle.log_path) \
+        else handle._log_tmp
+    assert status == "SUCCEEDED", open(log).read()[-2000:]
     results = handle.results()
     assert len(results) == 1
     assert results[0]["job_name"] == "launched-mnist"
