@@ -107,10 +107,12 @@ class HostAsyncRunner:
         self.devices = list(devices) if devices else [jax.devices()[0]]
         self.worker_devices: list = []  # actual placement, for tests/logs
         self.window_clocks: list = []   # merged commit clocks, last run
+        self.merged_windows: list = []  # (clock, staleness, steps) tuples
 
     def run(self, init_params, epoch_shards: Sequence[Sequence[Sequence[dict]]],
             checkpointer=None, checkpoint_folds: int = 0,
-            start_clock: int = 0) -> tuple:
+            start_clock: int = 0, ps=None, worker_offset: int = 0,
+            fetch_final: bool = True) -> tuple:
         """``epoch_shards[epoch][worker]`` is that worker's list of staged
         rounds for that epoch (per-epoch staging preserves the sync path's
         reshuffle-every-epoch semantics; pass the same object per epoch when
@@ -125,12 +127,20 @@ class HostAsyncRunner:
         stall on checkpoint IO (an in-commit-path save would skew the real
         scheduling this mode exists to measure). The PS lock makes each
         pulled snapshot internally consistent. ``start_clock`` seeds the
-        server clock when resuming from such a snapshot."""
+        server clock when resuming from such a snapshot.
+
+        ``ps``: inject a live parameter server instead of creating one —
+        the cross-process mode (parallel/remote_ps.py) passes process 0's
+        service-fronted PS here on process 0 and a RemoteParameterServer
+        client elsewhere; the worker loop cannot tell the difference.
+        ``worker_offset``: this process's first GLOBAL worker id (keeps
+        dropout fold keys distinct across processes)."""
         num_workers = len(epoch_shards[0])
-        # center (and its folds) live on device 0; workers pull it across
-        ps = server_for(self.strategy,
-                        jax.device_put(init_params, self.devices[0]))
-        ps.num_updates = int(start_clock)
+        if ps is None:
+            # center (and its folds) live on device 0; workers pull across
+            ps = server_for(self.strategy,
+                            jax.device_put(init_params, self.devices[0]))
+            ps.num_updates = int(start_clock)
         # per-window records: (commit_clock, staleness, [per-step metrics])
         windows: list[list[tuple]] = [[] for _ in range(num_workers)]
         errors: list = []
@@ -178,7 +188,7 @@ class HostAsyncRunner:
                         carry, commit, ms = self.window_fn(
                             carry, jax.device_put(center, dev),
                             jax.device_put(batches, dev),
-                            np.int32(k * 1_000_003 + fold))
+                            np.int32((worker_offset + k) * 1_000_003 + fold))
                         jax.block_until_ready(commit)
                         clock_at_fold = ps.commit(commit, last_update=clock)
                         ms = device_get_batched(ms)
@@ -213,14 +223,98 @@ class HostAsyncRunner:
             saver_thread.join()
         if errors:
             raise errors[0]
-        center, _ = ps.pull()
         # merge worker windows by the server clock at their commit — the
         # wall-clock order the center actually absorbed them in
         merged = sorted((w for ws in windows for w in ws), key=lambda w: w[0])
         self.window_clocks = [w[0] for w in merged]  # for tests/diagnostics
+        self.merged_windows = merged  # cross-process history upload source
         history = [step for _, _, steps in merged for step in steps]
         stal = [float(s) for _, s, _ in merged]
+        if not fetch_final:
+            # cross-process caller takes center/clock from the history
+            # barrier instead; skipping here saves a redundant full-params
+            # transfer (+ a clock roundtrip) per remote process
+            return None, history, stal, -1
+        center, _ = ps.pull()
         return device_get_batched(center), history, stal, ps.num_updates
+
+
+def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
+                      *, worker_offset: int, checkpointer=None,
+                      checkpoint_folds: int = 0, start_clock: int = 0,
+                      service_port: int = 0,
+                      history_timeout: float = 600.0) -> tuple:
+    """Pod-scale TRUE-async: this process's worker threads against ONE live
+    center owned by process 0 (VERDICT r4 ask #2 — the reference's
+    workers-on-separate-machines semantics).
+
+    Process 0 hosts the device-resident PS behind a
+    :class:`~distkeras_tpu.parallel.remote_ps.ParameterServerService`; its
+    own workers hit the PS object directly (no loopback tax), every other
+    process's workers pull/commit through a RemoteParameterServer client.
+    Staleness is real cross-host interleaving on the server clock.
+
+    End of run: every process uploads its commit-clock-tagged windows;
+    ``history_get`` doubles as the completion barrier (it blocks until all
+    processes uploaded) and returns the clock-merged global history plus
+    the final center — so every process returns IDENTICAL
+    ``(params, history, staleness, num_updates)``, matching the sync
+    path's process-transparency. Checkpointing runs only on process 0
+    (it owns the center; snapshot cadence is evaluated at its workers'
+    commit clocks, which carry the global count).
+    """
+    from jax.experimental import multihost_utils
+
+    from distkeras_tpu.parallel import remote_ps as rps
+
+    pid = jax.process_index()
+    service = client = None
+    try:
+        if pid == 0:
+            ps = server_for(runner.strategy,
+                            jax.device_put(init_params, runner.devices[0]))
+            ps.num_updates = int(start_clock)
+            service = rps.ParameterServerService(
+                ps, init_params, expected_processes=jax.process_count(),
+                port=service_port)
+            service.start()
+            rps.share_service_address(service.port)
+            local_ps = ps
+        else:
+            addr = rps.share_service_address(None)
+            # socket timeout must outlive the history barrier, or a slow
+            # pod turns the server's informative barrier-timeout error
+            # into a bare client-side socket.timeout
+            client = rps.RemoteParameterServer(
+                addr, init_params, timeout=history_timeout + 60.0)
+            local_ps = client
+            # the authoritative start state lives at the center (matters on
+            # resume: process 0 restored it; also seeds EASGD replicas)
+            init_params, _ = client.pull()
+        runner.run(init_params, epoch_shards,
+                   checkpointer=checkpointer if pid == 0 else None,
+                   checkpoint_folds=checkpoint_folds if pid == 0 else 0,
+                   start_clock=start_clock, ps=local_ps,
+                   worker_offset=worker_offset, fetch_final=False)
+        if pid == 0:
+            service.put_history(0, runner.merged_windows)
+            merged, center, clock = service.get_history_blocking(
+                timeout=history_timeout)
+        else:
+            client.put_history(pid, runner.merged_windows)
+            merged, center, clock = client.get_history(
+                timeout=history_timeout)
+        # everyone holds the final state before process 0 tears the
+        # service down (a late reader must not hit a dead socket)
+        multihost_utils.sync_global_devices("distkeras_host_async_done")
+    finally:
+        if client is not None:
+            client.close()
+        if service is not None:
+            service.stop()
+    history = [step for _, _, steps in merged for step in steps]
+    stal = [float(s) for _, s, _ in merged]
+    return device_get_batched(center), history, stal, int(clock)
 
 
 def stage_worker_shards(shards, features_col: str, label_col: str,

@@ -294,10 +294,11 @@ class DistributedTrainer(Trainer):
                 f"data_layout must be 'replicated' (every process holds the "
                 f"full dataset) or 'host_sharded' (each process's dataset "
                 f"holds ONLY its own workers' rows), got {data_layout!r}")
-        if data_layout == "host_sharded" and mode == "host_async":
-            raise ValueError(
-                "data_layout='host_sharded' is a multi-process mesh "
-                "contract; host_async workers are threads in one process")
+        # host_sharded x host_async IS supported (r5): each process's
+        # dataset holds only its own workers' rows and its threads commit
+        # to process 0's live center over the parameter service
+        # (parallel/remote_ps.py). Single-process it degenerates to
+        # replicated (all workers are local).
         # Multi-process input contract. 'replicated': every process holds
         # the same full dataset and put_global carves its part (simple, but
         # each host pays full-epoch host RAM + slicing). 'host_sharded':
@@ -462,16 +463,82 @@ class DistributedTrainer(Trainer):
         (default: one full round, ``num_workers`` folds). ``resume=True``
         restores the latest snapshot: workers restart their data passes from
         the beginning, but pull the restored center and continue its clock —
-        the same semantics as a reference worker rejoining a live server."""
+        the same semantics as a reference worker rejoining a live server.
+
+        Multi-process (``jax.process_count() > 1``): ``num_workers`` is the
+        GLOBAL thread count, split near-evenly over processes; process 0
+        owns the live center behind a socket parameter service and the
+        other processes' threads pull/commit through it — TRUE cross-host
+        asynchrony with real server-clock staleness (remote_ps.py). Data
+        per ``data_layout``: 'replicated' slices this process's workers'
+        shards out of the identical full dataset; 'host_sharded' means the
+        local dataset holds ONLY this process's workers' rows. Result
+        (params/history/staleness/num_updates) is identical on every
+        process. Checkpointing/resume runs on process 0 alone (it owns the
+        center; remote processes receive the restored center at their
+        first pull)."""
         from distkeras_tpu.parallel import host_async
 
         self._start()
-        self._check_trainable(
-            dataset,
-            self.batch_size * self.communication_window * self.num_workers)
+        multi = jax.process_count() > 1
+        pid = jax.process_index()
+        if multi:
+            P = jax.process_count()
+            if self.num_workers < P:
+                # globally-known condition: raise SYMMETRICALLY on every
+                # process (a one-sided raise would hang peers in the
+                # collectives ahead)
+                raise ValueError(
+                    f"num_workers={self.num_workers} < process_count={P}: "
+                    f"some process would own no workers")
+            counts = [self.num_workers // P + (1 if i < self.num_workers % P
+                                               else 0) for i in range(P)]
+            worker_offset = sum(counts[:pid])
+            local_workers = counts[pid]
+        else:
+            worker_offset, local_workers = 0, self.num_workers
+        if self.data_layout == "host_sharded" and multi:
+            # local dataset = ONLY this process's workers' rows. Data
+            # sufficiency is per-process state, so validate it with a tiny
+            # allgather and raise on EVERY process (same hazard as the
+            # sync path's rounds negotiation: a local raise leaves peers
+            # hanging in share_service_address / the history barrier).
+            from jax.experimental import multihost_utils
+
+            per_round = self.batch_size * self.communication_window
+            min_shard = len(dataset) // local_workers
+            oks = np.asarray(multihost_utils.process_allgather(
+                np.int64(min_shard // per_round))).ravel()
+            if oks.min() == 0:
+                short = np.flatnonzero(oks == 0).tolist()
+                raise ValueError(
+                    f"Process(es) {short} cannot form one round of "
+                    f"window={self.communication_window} x "
+                    f"batch={self.batch_size} per local worker (this host "
+                    f"is process {pid} with {len(dataset)} rows over "
+                    f"{local_workers} workers)")
+
+            def stage(ds):
+                return host_async.stage_worker_shards(
+                    ds.repartition(local_workers), self.features_col,
+                    self.label_col, self.batch_size,
+                    self.communication_window)
+        else:
+            self._check_trainable(
+                dataset,
+                self.batch_size * self.communication_window
+                * self.num_workers)
+
+            def stage(ds):
+                shards = ds.repartition(self.num_workers)
+                return host_async.stage_worker_shards(
+                    shards[worker_offset:worker_offset + local_workers],
+                    self.features_col, self.label_col, self.batch_size,
+                    self.communication_window)
+
         state = self._init_params(dataset)
         init_params, start_clock = state.params, 0
-        ckpt = self._checkpointer()
+        ckpt = self._checkpointer() if (not multi or pid == 0) else None
         if ckpt is not None:
             try:
                 snap, _ = self._maybe_resume(
@@ -483,11 +550,6 @@ class DistributedTrainer(Trainer):
             init_params = snap["center"]
             start_clock = int(np.asarray(snap["clock"])[0])
 
-        def stage(ds):
-            return host_async.stage_worker_shards(
-                ds.repartition(self.num_workers), self.features_col,
-                self.label_col, self.batch_size, self.communication_window)
-
         if shuffle:  # per-epoch reshuffle, matching the sync path
             epoch_shards = [stage(dataset.shuffle(self.seed + e))
                             for e in range(self.num_epoch)]
@@ -497,15 +559,21 @@ class DistributedTrainer(Trainer):
             self._async_runner = host_async.HostAsyncRunner(
                 self.model, self.loss, self.tx, self.strategy,
                 self.communication_window, self.metrics, self.seed,
-                devices=self.devices or jax.devices())
+                devices=self.devices or jax.local_devices())
         runner = self._async_runner
+        folds = (self.checkpoint_folds or self.num_workers) \
+            if ckpt is not None else 0
         try:
-            params, history, staleness, num_updates = runner.run(
-                init_params, epoch_shards,
-                checkpointer=ckpt,
-                checkpoint_folds=(self.checkpoint_folds or self.num_workers)
-                if ckpt is not None else 0,
-                start_clock=start_clock)
+            if multi:
+                params, history, staleness, num_updates = \
+                    host_async.run_cross_process(
+                        runner, init_params, epoch_shards,
+                        worker_offset=worker_offset, checkpointer=ckpt,
+                        checkpoint_folds=folds, start_clock=start_clock)
+            else:
+                params, history, staleness, num_updates = runner.run(
+                    init_params, epoch_shards, checkpointer=ckpt,
+                    checkpoint_folds=folds, start_clock=start_clock)
         except BaseException:
             if ckpt is not None:  # crash path: finalize in-flight snapshots
                 try:              # so resume sees the last completed one

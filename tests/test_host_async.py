@@ -77,6 +77,24 @@ def test_single_chip_ok():
     assert t.num_updates == 8 * (512 // 8 // 16)
 
 
+def test_host_sharded_degenerates_to_replicated_single_process():
+    """data_layout='host_sharded' x host_async is legal (r5: the pod-scale
+    contract, remote_ps.py); with ONE process every worker is local, so it
+    must train exactly like the replicated layout."""
+    ds = synthetic_mnist(n=512)
+    kw = dict(mode="host_async", num_workers=4, worker_optimizer="sgd",
+              learning_rate=0.05, metrics=(), batch_size=8,
+              communication_window=2, num_epoch=1)
+    t_hs = ADAG(_model(), data_layout="host_sharded", **kw)
+    t_hs.train(ds)
+    assert t_hs.num_updates == 4 * (512 // 4 // 16)
+    # same commit count and learnable history as the replicated layout
+    t_rep = ADAG(_model(), **kw)
+    t_rep.train(ds)
+    assert t_hs.num_updates == t_rep.num_updates
+    assert len(t_hs.history) == len(t_rep.history)
+
+
 def _held_out_loss(model, params, ds, n=256):
     """Loss of a parameter set on the first n rows — the convergence metric
     that does NOT depend on thread scheduling (history positions do)."""

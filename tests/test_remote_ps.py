@@ -1,0 +1,149 @@
+"""Unit tests for the cross-process parameter service (remote_ps.py).
+
+The two-process trainer path is covered by
+tests/test_multihost.py::test_two_process_true_async_live_center; these
+exercise the wire, codec, dispatch, and history barrier in-process (the
+service genuinely runs over a loopback socket here — only the second
+process is missing).
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.parameter_servers import (
+    DeltaParameterServer,
+    DynSGDParameterServer,
+)
+from distkeras_tpu.parallel.remote_ps import (
+    ParameterServerService,
+    RemoteParameterServer,
+    _TreeCodec,
+)
+
+PARAMS = {"w": jnp.ones((4, 3), jnp.float32),
+          "b": jnp.zeros((3,), jnp.float32)}
+
+
+def _service(ps_cls=DeltaParameterServer, expected=1):
+    ps = ps_cls(jax.device_put(PARAMS))
+    svc = ParameterServerService(ps, PARAMS, expected_processes=expected)
+    svc.start()
+    return ps, svc
+
+
+def test_codec_roundtrip_and_validation():
+    codec = _TreeCodec(PARAMS)
+    blobs = codec.encode(PARAMS)
+    out = codec.decode(blobs)
+    np.testing.assert_array_equal(out["w"], np.ones((4, 3), np.float32))
+    with pytest.raises(ValueError, match="blobs"):
+        codec.decode(blobs[:1])
+    with pytest.raises(ValueError, match="shape"):
+        codec.decode([b"\x00" * 4, blobs[1]])
+    with pytest.raises(ValueError, match="leaves"):
+        codec.encode({"w": PARAMS["w"]})
+
+
+def test_pull_commit_clock_over_the_wire():
+    ps, svc = _service()
+    try:
+        cli = RemoteParameterServer(f"127.0.0.1:{svc.port}", PARAMS)
+        center, clock = cli.pull()
+        assert clock == 0
+        np.testing.assert_array_equal(center["w"],
+                                      np.ones((4, 3), np.float32))
+        delta = {"w": np.full((4, 3), 0.5, np.float32),
+                 "b": np.ones((3,), np.float32)}
+        assert cli.commit(delta, last_update=clock) == 0
+        assert cli.num_updates == 1
+        center2, clock2 = cli.pull()
+        assert clock2 == 1
+        np.testing.assert_allclose(center2["w"],
+                                   np.full((4, 3), 1.5, np.float32))
+        # the device-resident center REALLY moved (not a client-side copy)
+        host_center, _ = ps.pull()
+        np.testing.assert_allclose(np.asarray(host_center["b"]),
+                                   np.ones((3,), np.float32))
+        cli.close()
+    finally:
+        svc.stop()
+
+
+def test_dynsgd_staleness_crosses_the_wire():
+    """A stale remote commit (pulled at clock 0, folded at clock 1) must be
+    scaled by 1/(staleness+1) — the DynSGD rule applied at the SERVER."""
+    ps, svc = _service(DynSGDParameterServer)
+    try:
+        cli = RemoteParameterServer(f"127.0.0.1:{svc.port}", PARAMS)
+        _, clock0 = cli.pull()
+        one = {"w": np.ones((4, 3), np.float32),
+               "b": np.zeros((3,), np.float32)}
+        cli.commit(one, last_update=clock0)        # staleness 0: full fold
+        at = cli.commit(one, last_update=clock0)   # staleness 1: half fold
+        assert at == 1
+        center, _ = cli.pull()
+        np.testing.assert_allclose(center["w"][0, 0], 1.0 + 1.0 + 0.5)
+        cli.close()
+    finally:
+        svc.stop()
+
+
+def test_concurrent_clients_serialize_at_the_center():
+    ps, svc = _service()
+    try:
+        clients = [RemoteParameterServer(f"127.0.0.1:{svc.port}", PARAMS)
+                   for _ in range(3)]
+        one = {"w": np.ones((4, 3), np.float32),
+               "b": np.zeros((3,), np.float32)}
+
+        def hammer(cli):
+            for _ in range(5):
+                _, clock = cli.pull()
+                cli.commit(one, last_update=clock)
+
+        ts = [threading.Thread(target=hammer, args=(c,)) for c in clients]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        center, clock = clients[0].pull()
+        assert clock == 15  # every commit folded exactly once
+        np.testing.assert_allclose(center["w"][0, 0], 16.0)
+        for c in clients:
+            c.close()
+    finally:
+        svc.stop()
+
+
+def test_history_barrier_merges_by_clock_and_times_out():
+    ps, svc = _service(expected=2)
+    try:
+        cli = RemoteParameterServer(f"127.0.0.1:{svc.port}", PARAMS)
+        cli.put_history(1, [(2, 1.0, [{"loss": 0.2}]),
+                            (0, 0.0, [{"loss": 1.0}])])
+        # only 1 of 2 processes uploaded: the barrier must time out loudly
+        with pytest.raises(RuntimeError, match="barrier"):
+            cli.get_history(timeout=0.2)
+        svc.put_history(0, [(1, 1.0, [{"loss": 0.5}])])
+        windows, center, clock = cli.get_history(timeout=5)
+        assert [w[0] for w in windows] == [0, 1, 2]  # clock-merged
+        assert windows[1][2] == [{"loss": 0.5}]
+        assert clock == 0
+        cli.close()
+    finally:
+        svc.stop()
+
+
+def test_unknown_op_is_rejected():
+    ps, svc = _service()
+    try:
+        cli = RemoteParameterServer(f"127.0.0.1:{svc.port}", PARAMS)
+        with pytest.raises(RuntimeError, match="unknown op"):
+            cli._roundtrip({"op": "exec"})
+        cli.close()
+    finally:
+        svc.stop()
