@@ -135,6 +135,12 @@ def main():
         # window-normalized so the server step is λ-invariant) halves the
         # center-fold count vs window=8. Measured r4 sweep at 384 steps:
         # w8 r48 54.67%, w16 r24 54.80% MFU (w8 r24 = 192 steps: 54.43%).
+        # Convergence side of the window choice: STALENESS_r05.json /
+        # DESIGN.md §2b — at num_workers=1 there are no other committers
+        # (staleness 0), so w16 is convergence-free here; the curve
+        # quantifies what window costs at K=8 (w1 1.09 -> w16 2.27 final
+        # held-out on the probe task), which is why the window is a
+        # measured trade-off knob, not folklore.
         # uint8 staging keeps the 384-step chunk at ~7.4 GB HBM (staged
         # bytes depend on rounds x window x batch, unchanged by the w16
         # re-split). The fallback config is deliberately small (OOM
