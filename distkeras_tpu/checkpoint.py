@@ -37,6 +37,10 @@ class Checkpointer:
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
                                                  create=True),
+            # declare the handler up front: metadata() must be able to read
+            # a step's shapes in a FRESH manager that has neither saved nor
+            # restored yet (elastic-resume topology probe)
+            item_handlers=ocp.StandardCheckpointHandler(),
         )
 
     def save(self, step: int, state: Any, wait: bool = False) -> None:
@@ -57,6 +61,19 @@ class Checkpointer:
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
+
+    def metadata(self, step: Optional[int] = None):
+        """Shapes/dtypes of a saved step WITHOUT reading array data — the
+        topology probe for elastic resume (a trainer can learn the worker
+        count a checkpoint was written with before committing to a
+        full-shape restore)."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"No checkpoint found under {self.directory}")
+        meta = self._mgr.item_metadata(int(step))
+        return getattr(meta, "tree", meta)
 
     def clear(self) -> None:
         """Delete every saved step. Orbax's CheckpointManager silently SKIPS

@@ -92,26 +92,29 @@ class Trainer:
         return Checkpointer(self.checkpoint_dir)
 
     @staticmethod
-    def _maybe_resume(ckpt, like: dict, resume: bool) -> tuple:
-        """(state_dict, start_epoch): restore the latest epoch checkpoint if
-        asked and present. History is NOT checkpointed — a resumed trainer's
-        history covers only the epochs it ran.
-
-        A pre-existing non-empty checkpoint dir with ``resume=False`` is an
-        ERROR: Orbax skips saves for steps that already exist, so keeping
+    def _check_fresh_dir(ckpt) -> None:
+        """A pre-existing non-empty checkpoint dir with ``resume=False`` is
+        an ERROR: Orbax skips saves for steps that already exist, so keeping
         the stale steps would make the fresh run's snapshots silent no-ops
         (and a crash retry would then resume the stale previous run), while
         deleting them silently would destroy a prior run's checkpoints."""
+        if ckpt.latest_step() is not None:
+            raise ValueError(
+                f"checkpoint_dir {ckpt.directory!r} already contains "
+                f"steps {ckpt.all_steps()} but resume=False. Pass "
+                "resume=True to continue that run, point checkpoint_dir "
+                "at a fresh directory, or clear it explicitly "
+                "(distkeras_tpu.checkpoint.Checkpointer(dir).clear())")
+
+    @staticmethod
+    def _maybe_resume(ckpt, like: dict, resume: bool) -> tuple:
+        """(state_dict, start_epoch): restore the latest epoch checkpoint if
+        asked and present. History is NOT checkpointed — a resumed trainer's
+        history covers only the epochs it ran."""
         if ckpt is None:
             return like, 0
         if not resume:
-            if ckpt.latest_step() is not None:
-                raise ValueError(
-                    f"checkpoint_dir {ckpt.directory!r} already contains "
-                    f"steps {ckpt.all_steps()} but resume=False. Pass "
-                    "resume=True to continue that run, point checkpoint_dir "
-                    "at a fresh directory, or clear it explicitly "
-                    "(distkeras_tpu.checkpoint.Checkpointer(dir).clear())")
+            Trainer._check_fresh_dir(ckpt)
             return like, 0
         if ckpt.latest_step() is None:
             return like, 0
@@ -353,6 +356,118 @@ class DistributedTrainer(Trainer):
         state = self._init_params(dataset)
         return self._init_carries(state.params)
 
+    def _resume_elastic(self, ckpt, center, carries, resume: bool):
+        """Topology-aware resume: ``(center, carries, counters, start_epoch)``
+        where counters = [round_offset, num_updates, saved_num_workers].
+
+        Same worker count (the checkpoint's carries probe via
+        ``Checkpointer.metadata`` — no array data read): full restore,
+        bit-identical continuation, regardless of ``parallelism_factor``
+        (K logical workers on D devices equal K on K by construction).
+
+        Different worker count (SURVEY §5 slice-resize: a preempted v4-32
+        job resuming on a smaller slice): restore the CENTER + counters
+        only, re-initialize every worker replica from the restored center,
+        and warn loudly — worker-local state (elastic replicas, momenta,
+        optimizer slots) is discarded, the same trajectory break a
+        reference worker rejoining a live server saw. Strategies that
+        never exchange (Averaging/Ensemble) refuse: their training state
+        LIVES in the per-worker replicas, so a center-only restore would
+        silently discard the training itself."""
+        zero = np.zeros((3,), np.int64)
+        if ckpt is None:
+            return center, carries, zero, 0
+        if not resume:
+            self._check_fresh_dir(ckpt)
+            return center, carries, zero, 0
+        step = ckpt.latest_step()
+        if step is None:
+            return center, carries, zero, 0
+        meta = ckpt.metadata(step)
+        if not isinstance(meta, dict) or "carries" not in meta or \
+                meta["carries"] is None:
+            keys = sorted(meta) if isinstance(meta, dict) else type(meta)
+            raise ValueError(
+                f"checkpoint step {step} in {ckpt.directory!r} has no "
+                f"'carries' item (found {keys}); it was written by a "
+                f"different mode/trainer (host_async snapshots are "
+                f"center+clock, PjitTrainer/SingleTrainer save a "
+                f"TrainState). Resume it with the mode it was written in.")
+        carry_meta = jax.tree.leaves(meta["carries"])
+        saved_workers = int(carry_meta[0].shape[0])
+        # counters length may be 2 (pre-r5 format, no worker count recorded)
+        counters_like = jax.ShapeDtypeStruct(
+            tuple(meta["counters"].shape), np.int64)
+
+        def parse_counters(raw) -> np.ndarray:
+            out = zero.copy()
+            got = np.asarray(raw).ravel()
+            out[:min(3, len(got))] = got[:3]
+            if len(got) < 3:
+                out[2] = saved_workers
+            return out
+
+        if saved_workers == self.num_workers:
+            # compare saved vs current carry shapes BEFORE restoring, so a
+            # strategy change is a clear naming error while genuine I/O or
+            # corruption errors propagate untouched from Orbax
+            saved_shapes = sorted(tuple(m.shape) for m in carry_meta)
+            cur_shapes = sorted(tuple(np.shape(l))
+                                for l in jax.tree.leaves(carries))
+            if saved_shapes != cur_shapes:
+                raise ValueError(
+                    f"checkpoint step {step} matches "
+                    f"num_workers={saved_workers} but its carry structure "
+                    f"does not match this trainer's "
+                    f"strategy ({self.strategy.name!r}); resuming needs "
+                    f"the same strategy the checkpoint was written with")
+            snap = ckpt.restore(
+                like={"center": center, "carries": carries,
+                      "counters": counters_like}, step=step)
+            return (snap["center"], snap["carries"],
+                    parse_counters(snap["counters"]), step + 1)
+        if not self.strategy.exchanges:
+            raise ValueError(
+                f"Cannot elastically resume {type(self).__name__} across a "
+                f"topology change (checkpoint: {saved_workers} workers, "
+                f"trainer: {self.num_workers}): with the "
+                f"{self.strategy.name!r} strategy the training state lives "
+                f"in the per-worker replicas (the center never moves), so "
+                f"a center-only restore would discard the training. Resume "
+                f"with num_workers={saved_workers}.")
+        import warnings
+
+        warnings.warn(
+            f"ELASTIC RESUME: checkpoint step {step} was written by a "
+            f"{saved_workers}-worker run; this trainer has "
+            f"{self.num_workers}. Restoring the CENTER + counters only "
+            f"and re-initializing every worker replica from the restored "
+            f"center — worker-local state (elastic replicas, momenta, "
+            f"optimizer slots) is discarded, so the continuation is a "
+            f"documented trajectory break from the uninterrupted run.",
+            RuntimeWarning, stacklevel=3)
+        # Restore EVERYTHING to host numpy: numpy abstracts carry no
+        # sharding, so Orbax never consults the checkpoint's sharding file
+        # (which references the OLD device topology — the exact thing a
+        # slice-resize resume no longer has). The wrong-topology carries
+        # are read into host RAM and discarded; only the center survives,
+        # re-placed by _init_carries on the new mesh. (Cost: one host-RAM
+        # read of the old carries; a future format split of carries into
+        # their own checkpoint item would skip even that.)
+        center_host_like = jax.tree.map(
+            lambda x: np.zeros(np.shape(x), np.asarray(x).dtype),
+            device_get_batched(center))
+        abstract_saved = jax.tree.map(
+            lambda m: np.zeros(tuple(m.shape), np.dtype(str(m.dtype))),
+            meta["carries"])
+        snap = ckpt.restore(
+            like={"center": center_host_like, "carries": abstract_saved,
+                  "counters": np.zeros(tuple(meta["counters"].shape),
+                                       np.int64)}, step=step)
+        new_center, new_carries = self._init_carries(snap["center"])
+        return (new_center, new_carries, parse_counters(snap["counters"]),
+                step + 1)
+
     def train(self, dataset: Dataset, shuffle: bool = False,
               resume: bool = False):
         from distkeras_tpu.parallel import substrate
@@ -391,10 +506,16 @@ class DistributedTrainer(Trainer):
             self._warn_if_large_resident(dataset, "staging_rounds")
         center, carries = self._setup_state(dataset)
         ckpt = self._checkpointer()
-        snap, start_epoch = self._maybe_resume(
-            ckpt, {"center": center, "carries": carries,
-                   "counters": np.zeros((2,), np.int64)}, resume)
-        center, carries = snap["center"], snap["carries"]
+        if ckpt is not None:
+            try:
+                center, carries, counters, start_epoch = \
+                    self._resume_elastic(ckpt, center, carries, resume)
+            except BaseException:  # don't leak the manager's threads/locks
+                ckpt.close()
+                raise
+        else:
+            center, carries, counters, start_epoch = self._resume_elastic(
+                ckpt, center, carries, resume)
         # compiled once per trainer instance: every ctor arg the closure
         # depends on is fixed at construction, so repeated train() calls
         # (warm restarts, benchmark loops) reuse the jit cache instead of
@@ -407,8 +528,8 @@ class DistributedTrainer(Trainer):
         epoch_fn = self._epoch_fn
         self.history = []
         self.staleness_history = []
-        round_offset = int(np.asarray(snap["counters"])[0])
-        self.num_updates = int(np.asarray(snap["counters"])[1])
+        round_offset = int(counters[0])
+        self.num_updates = int(counters[1])
         staged = None  # shuffle=False + whole-epoch staging: stage once
         for epoch in range(start_epoch, self.num_epoch):
             # One code path for both staging modes: staging_rounds=None is
@@ -437,10 +558,12 @@ class DistributedTrainer(Trainer):
             for ms, rounds in pending:
                 self._record(device_get_batched(ms), rounds)
             if ckpt is not None:
+                # counters[2] records the topology so a later resume can
+                # detect a worker-count change before any shape restore
                 ckpt.save(epoch, {"center": center, "carries": carries,
                                   "counters": np.array(
-                                      [round_offset, self.num_updates],
-                                      np.int64)})
+                                      [round_offset, self.num_updates,
+                                       self.num_workers], np.int64)})
         if ckpt is not None:
             ckpt.wait()
             ckpt.close()

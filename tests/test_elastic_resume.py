@@ -1,0 +1,166 @@
+"""Elastic resume: center-only restore onto a DIFFERENT topology.
+
+VERDICT r4 ask #4 / SURVEY §5 slice-resize: a preempted 8-worker run must
+be resumable on 4 workers (restore the center + counters, re-init carries
+from the center, warn loudly), a parallelism_factor change with the same
+logical worker count must continue bit-identically, and strategies whose
+state lives in the replicas (Averaging/Ensemble) must refuse with a clear
+error instead of an Orbax shape failure.
+"""
+
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from distkeras_tpu import ADAG, AveragingTrainer, EAMSGD
+from distkeras_tpu.data.dataset import synthetic_mnist
+from distkeras_tpu.models.mlp import MLP
+
+
+def _model():
+    return MLP(features=(16,), dropout_rate=0.0)
+
+
+def _kw(**over):
+    kw = dict(worker_optimizer="sgd", learning_rate=0.05, metrics=(),
+              batch_size=8, communication_window=2)
+    kw.update(over)
+    return kw
+
+
+def _checksum(params):
+    return float(sum(np.abs(np.asarray(l)).sum()
+                     for l in jax.tree.leaves(params)))
+
+
+def test_resume_on_fewer_workers_continues_and_learns(tmp_path):
+    ds = synthetic_mnist(n=1024)
+    t8 = ADAG(_model(), num_workers=8, num_epoch=2,
+              checkpoint_dir=str(tmp_path / "ck"), **_kw())
+    t8.train(ds)
+    saved_updates = t8.num_updates
+    assert saved_updates > 0
+
+    t4 = ADAG(_model(), num_workers=4, num_epoch=4,
+              checkpoint_dir=str(tmp_path / "ck"), **_kw())
+    with pytest.warns(RuntimeWarning, match="ELASTIC RESUME"):
+        t4.train(ds, resume=True)
+    # continued at epoch 2: only epochs 2-3 ran, at the 4-worker geometry
+    rounds_per_epoch = 1024 // 4 // 16
+    assert len(t4.staleness_history) == 2 * rounds_per_epoch
+    # the commit clock CONTINUED from the 8-worker run's counters
+    assert t4.num_updates == saved_updates + 2 * rounds_per_epoch * 4
+    losses = [h["loss"] for h in t4.history]
+    assert np.isfinite(losses).all()
+    # it resumed from the trained center, not from scratch: first resumed
+    # loss is far below a fresh init's first loss (~2.5)
+    assert losses[0] < 2.0
+    assert losses[-1] <= losses[0]
+
+
+def test_resume_on_more_workers(tmp_path):
+    ds = synthetic_mnist(n=1024)
+    t2 = ADAG(_model(), num_workers=2, num_epoch=1,
+              checkpoint_dir=str(tmp_path / "ck"), **_kw())
+    t2.train(ds)
+    t8 = ADAG(_model(), num_workers=8, num_epoch=2,
+              checkpoint_dir=str(tmp_path / "ck"), **_kw())
+    with pytest.warns(RuntimeWarning, match="ELASTIC RESUME"):
+        t8.train(ds, resume=True)
+    assert len(t8.staleness_history) == 1024 // 8 // 16  # one epoch ran
+    assert np.isfinite([h["loss"] for h in t8.history]).all()
+
+
+def test_parallelism_factor_change_is_a_full_restore(tmp_path):
+    """8 logical workers on 8 devices == 8 logical on 4 devices x factor 2
+    (substrate guarantee), so resuming across a parallelism_factor change
+    is NOT elastic — it is a bit-identical full restore, no warning."""
+    ds = synthetic_mnist(n=1024)
+    t = ADAG(_model(), num_workers=8, num_epoch=1,
+             checkpoint_dir=str(tmp_path / "ck"), **_kw())
+    t.train(ds)
+
+    def resume(factor):
+        kw = dict(num_epoch=2, checkpoint_dir=str(tmp_path / "ck"), **_kw())
+        if factor == 1:
+            tr = ADAG(_model(), num_workers=8, **kw)
+        else:
+            from distkeras_tpu.parallel import mesh as mesh_lib
+
+            tr = ADAG(_model(), parallelism_factor=factor,
+                      mesh=mesh_lib.make_mesh(num_workers=8 // factor), **kw)
+        assert tr.num_workers == 8
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", RuntimeWarning)  # no elastic warn
+            tr.train(ds, resume=True)
+        return tr
+
+    t_plain = resume(1)
+    # fresh dir for the factor run (the first resume already advanced it)
+    t_factor = ADAG(_model(), num_workers=8, num_epoch=1,
+                    checkpoint_dir=str(tmp_path / "ck2"), **_kw())
+    t_factor.train(ds)
+    from distkeras_tpu.parallel import mesh as mesh_lib
+
+    t_f2 = ADAG(_model(), parallelism_factor=2,
+                mesh=mesh_lib.make_mesh(num_workers=4), num_epoch=2,
+                checkpoint_dir=str(tmp_path / "ck2"), **_kw())
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        t_f2.train(ds, resume=True)
+    # identical trajectory: factor-2 resume == plain resume (same logical K)
+    np.testing.assert_allclose(_checksum(t_f2.params),
+                               _checksum(t_plain.params), rtol=1e-6)
+    assert [round(h["loss"], 6) for h in t_f2.history] == \
+        [round(h["loss"], 6) for h in t_plain.history]
+
+
+def test_averaging_refuses_topology_change_with_clear_error(tmp_path):
+    ds = synthetic_mnist(n=1024)
+    t = AveragingTrainer(_model(), num_workers=8, num_epoch=1,
+                         checkpoint_dir=str(tmp_path / "ck"), **_kw())
+    t.train(ds)
+    t4 = AveragingTrainer(_model(), num_workers=4, num_epoch=2,
+                          checkpoint_dir=str(tmp_path / "ck"), **_kw())
+    with pytest.raises(ValueError, match="center-only restore would "
+                       "discard the training"):
+        t4.train(ds, resume=True)
+
+
+def test_strategy_change_same_topology_is_a_clear_error(tmp_path):
+    """Same worker count but different strategy (different carry
+    structure): a clear error naming the strategy, not an Orbax dump."""
+    ds = synthetic_mnist(n=1024)
+    t = ADAG(_model(), num_workers=4, num_epoch=1,
+             checkpoint_dir=str(tmp_path / "ck"), **_kw())
+    t.train(ds)
+    t2 = EAMSGD(_model(), num_workers=4, num_epoch=2, rho=1.0,
+                checkpoint_dir=str(tmp_path / "ck"),
+                learning_rate=0.05, metrics=(), batch_size=8,
+                communication_window=2)
+    with pytest.raises(ValueError, match="strategy"):
+        t2.train(ds, resume=True)
+
+
+def test_legacy_two_counter_checkpoint_resumes(tmp_path):
+    """Pre-r5 checkpoints carry [round_offset, num_updates] only; a
+    same-topology resume must still work, inferring the worker count from
+    the carries' leading axis."""
+    from distkeras_tpu.checkpoint import Checkpointer
+
+    ds = synthetic_mnist(n=1024)
+    # write a legacy-format snapshot from a template trainer's state
+    ck = Checkpointer(str(tmp_path / "legacy"))
+    t_template = ADAG(_model(), num_workers=4, num_epoch=1, **_kw())
+    center, carries = t_template._setup_state(ds)
+    ck.save(0, {"center": center, "carries": carries,
+                "counters": np.array([7, 28], np.int64)}, wait=True)
+    ck.close()
+
+    t2 = ADAG(_model(), num_workers=4, num_epoch=2,
+              checkpoint_dir=str(tmp_path / "legacy"), **_kw())
+    t2.train(ds, resume=True)
+    rounds = 1024 // 4 // 16
+    assert t2.num_updates == 28 + rounds * 4  # clock continued
