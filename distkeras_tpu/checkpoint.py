@@ -48,14 +48,22 @@ class Checkpointer:
         if wait:
             self._mgr.wait_until_finished()
 
-    def restore(self, like: Any, step: Optional[int] = None) -> Any:
-        """Restore the given (or latest) step into the structure of ``like``."""
+    def restore(self, like: Any, step: Optional[int] = None,
+                host: bool = False) -> Any:
+        """Restore the given (or latest) step into the structure of ``like``.
+
+        ``host=True`` restores into HOST numpy arrays (``like`` leaves must
+        be numpy): no sharding is attached or looked up from the
+        checkpoint's sharding file — required when restoring a checkpoint
+        written on a device topology that no longer exists (elastic
+        resume), where the recorded shardings reference dead devices."""
         if step is None:
             step = self.latest_step()
             if step is None:
                 raise FileNotFoundError(
                     f"No checkpoint found under {self.directory}")
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
+        abstract = like if host else jax.tree.map(
+            ocp.utils.to_shape_dtype_struct, like)
         return self._mgr.restore(int(step),
                                  args=ocp.args.StandardRestore(abstract))
 

@@ -395,9 +395,9 @@ class DistributedTrainer(Trainer):
                 f"TrainState). Resume it with the mode it was written in.")
         carry_meta = jax.tree.leaves(meta["carries"])
         saved_workers = int(carry_meta[0].shape[0])
-        # counters length may be 2 (pre-r5 format, no worker count recorded)
-        counters_like = jax.ShapeDtypeStruct(
-            tuple(meta["counters"].shape), np.int64)
+        # counters length may be 2 (pre-r5 format, no worker count
+        # recorded); numpy abstract = host restore, no sharding lookup
+        counters_like = np.zeros(tuple(meta["counters"].shape), np.int64)
 
         def parse_counters(raw) -> np.ndarray:
             out = zero.copy()
@@ -463,7 +463,7 @@ class DistributedTrainer(Trainer):
         snap = ckpt.restore(
             like={"center": center_host_like, "carries": abstract_saved,
                   "counters": np.zeros(tuple(meta["counters"].shape),
-                                       np.int64)}, step=step)
+                                       np.int64)}, step=step, host=True)
         new_center, new_carries = self._init_carries(snap["center"])
         return (new_center, new_carries, parse_counters(snap["counters"]),
                 step + 1)
