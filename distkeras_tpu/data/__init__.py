@@ -4,7 +4,8 @@ from distkeras_tpu.data.dataset import (
     ShardedColumn,
     synthetic_mnist,
 )
+from distkeras_tpu.data.global_shards import GlobalShards
 from distkeras_tpu.data.prefetch import prefetch
 
-__all__ = ["Dataset", "PermutedColumn", "ShardedColumn", "prefetch",
-           "synthetic_mnist"]
+__all__ = ["Dataset", "GlobalShards", "PermutedColumn", "ShardedColumn",
+           "prefetch", "synthetic_mnist"]

@@ -459,6 +459,104 @@ def test_two_process_true_async_live_center(tmp_path):
     assert heldout < 2.3 and heldout < init_l - 0.25
 
 
+GLOBAL_SHARDS_WORKER = textwrap.dedent("""
+    import os, sys
+    pid = int(sys.argv[1]); port = sys.argv[2]; repo = sys.argv[3]
+    pool_dir = os.environ["GS_POOL_DIR"]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    sys.path.insert(0, repo)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from distkeras_tpu.parallel import distributed
+    distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=2, process_id=pid)
+    import numpy as np
+    from distkeras_tpu import ADAG
+    from distkeras_tpu.data import GlobalShards
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.parallel.distributed import multihost_mesh
+
+    gs = GlobalShards({
+        "features": [os.path.join(pool_dir, f"f{i}.npy") for i in range(8)],
+        "label": [os.path.join(pool_dir, f"l{i}.npy") for i in range(8)],
+    }, seed=5)
+    # this host's shard sets: re-dealt between epochs, union = whole pool
+    a = [gs.epoch_assignment(e) for e in (0, 1)]
+    t = ADAG(MLP(features=(16,), dropout_rate=0.0), worker_optimizer="sgd",
+             learning_rate=0.05, metrics=(), batch_size=8,
+             communication_window=2, num_epoch=2,
+             mesh=multihost_mesh(num_workers=8),
+             data_layout="host_sharded")
+    t.train(gs)
+    checksum = float(sum(np.abs(np.asarray(l)).sum()
+                         for l in jax.tree.leaves(t.params)))
+    print(f"GSOK proc={pid} e0={sorted(a[0][pid])} e1={sorted(a[1][pid])} "
+          f"u0={sorted(a[0][0]+a[0][1])} u1={sorted(a[1][0]+a[1][1])} "
+          f"n={len(t.history)} checksum={checksum:.6f}")
+""")
+
+
+def test_two_process_global_shards_mixes_across_hosts(tmp_path):
+    """VERDICT r4 ask #5: under GlobalShards, host 0's epoch-1 row set
+    differs from its epoch-0 set while each epoch's global multiset is the
+    whole pool; the two-process trajectory equals the single-process
+    oracle over the same (identically permuted) pool."""
+    import os
+    import re
+
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    pool = tmp_path / "pool"
+    pool.mkdir()
+    for i in range(8):
+        np.save(pool / f"f{i}.npy",
+                rng.standard_normal((64, 784)).astype(np.float32))
+        np.save(pool / f"l{i}.npy",
+                np.eye(10, dtype=np.float32)[rng.integers(0, 10, 64)])
+    os.environ["GS_POOL_DIR"] = str(pool)
+    try:
+        outs = _run_two_procs(tmp_path, GLOBAL_SHARDS_WORKER, timeout=300)
+    finally:
+        del os.environ["GS_POOL_DIR"]
+    vals = {}
+    for out in outs:
+        m = re.search(r"GSOK proc=(\d) e0=(\[[^\]]*\]) e1=(\[[^\]]*\]) "
+                      r"u0=(\[[^\]]*\]) u1=(\[[^\]]*\]) n=(\d+) "
+                      r"checksum=([\d.]+)", out)
+        assert m, out[-2000:]
+        vals[m.group(1)] = m.groups()[1:]
+    full = str(list(range(8)))
+    e0, e1, u0, u1, n, checksum = vals["0"]
+    # host 0 was re-dealt between epochs; the global multiset is preserved
+    assert e0 != e1
+    assert u0 == full and u1 == full
+    assert vals["0"][4:] == vals["1"][4:]  # same history len + params
+
+    # single-process oracle: same pool object stages the full permuted
+    # pool per epoch (P=1 assignment = the whole permutation)
+    import jax
+
+    from distkeras_tpu import ADAG
+    from distkeras_tpu.data import GlobalShards
+    from distkeras_tpu.models.mlp import MLP
+
+    gs = GlobalShards({
+        "features": [str(pool / f"f{i}.npy") for i in range(8)],
+        "label": [str(pool / f"l{i}.npy") for i in range(8)]}, seed=5)
+    t = ADAG(MLP(features=(16,), dropout_rate=0.0), worker_optimizer="sgd",
+             learning_rate=0.05, metrics=(), batch_size=8,
+             communication_window=2, num_epoch=2, num_workers=8,
+             data_layout="host_sharded")
+    t.train(gs)
+    ref = float(sum(np.abs(np.asarray(l)).sum()
+                    for l in jax.tree.leaves(t.params)))
+    assert int(n) == len(t.history)
+    np.testing.assert_allclose(float(checksum), ref, rtol=1e-5)
+
+
 def test_two_process_full_trainer_matches_single_process(tmp_path):
     """The PUBLIC ADAG trainer — staging, epochs, metric recording, final
     param fetch — runs unchanged on a two-process mesh and reproduces the
