@@ -65,11 +65,16 @@ class GlobalShards:
         if self.num_shards == 0:
             raise ValueError("GlobalShards needs at least one shard file")
         self.seed = int(seed)
-        mode = "r" if mmap else None
-        self._parts: Dict[str, List[np.ndarray]] = {
-            c: [np.load(p, mmap_mode=mode) for p in ps]
-            for c, ps in columns.items()}
-        sizes = {len(p) for ps in self._parts.values() for p in ps}
+        self._mmap = bool(mmap)
+        self._paths: Dict[str, List[str]] = {
+            c: [str(p) for p in ps] for c, ps in columns.items()}
+        # Validate row counts from the npy HEADERS alone: no memmaps (and
+        # no file descriptors) are held open here — a pool of thousands of
+        # shard files must not exhaust the fd limit at construction; files
+        # are opened lazily in epoch_dataset, only the shards assigned to
+        # this host this epoch.
+        sizes = {self._npy_rows(p)
+                 for ps in self._paths.values() for p in ps}
         if len(sizes) != 1:
             raise ValueError(
                 f"All shard files must hold the SAME row count (hosts must "
@@ -77,9 +82,23 @@ class GlobalShards:
                 f"sizes {sorted(sizes)}")
         self.rows_per_shard = sizes.pop()
 
+    @staticmethod
+    def _npy_rows(path: str) -> int:
+        """Leading-axis length read from the .npy header (fd closed on
+        return — nothing stays open)."""
+        with open(path, "rb") as f:
+            version = np.lib.format.read_magic(f)
+            if version >= (2, 0):
+                shape, _, _ = np.lib.format.read_array_header_2_0(f)
+            else:
+                shape, _, _ = np.lib.format.read_array_header_1_0(f)
+        if not shape:
+            raise ValueError(f"{path!r} holds a 0-d array, not rows")
+        return int(shape[0])
+
     @property
     def columns(self) -> List[str]:
-        return list(self._parts)
+        return list(self._paths)
 
     def __len__(self) -> int:
         """Total rows in the pool (all shards)."""
@@ -114,8 +133,9 @@ class GlobalShards:
         pi = process_index if process_index is not None else \
             jax.process_index()
         idxs = self.epoch_assignment(epoch, process_count)[pi]
+        mode = "r" if self._mmap else None
         out = {}
-        for c, parts in self._parts.items():
-            chosen = [parts[i] for i in idxs]
+        for c, paths in self._paths.items():
+            chosen = [np.load(paths[i], mmap_mode=mode) for i in idxs]
             out[c] = chosen[0] if len(chosen) == 1 else ShardedColumn(chosen)
         return Dataset(out)

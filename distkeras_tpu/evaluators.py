@@ -24,12 +24,21 @@ class AccuracyEvaluator(Evaluator):
     Accepts either index columns or one-hot/score vectors on both sides
     (argmax is applied to >=2-d columns), matching how the reference's
     examples feed it after LabelIndexTransformer.
+
+    ``across_processes=True`` aggregates under the pod-scale host-sharded
+    inference contract (DESIGN.md §3): every process scored ONLY its own
+    disjoint rows; the local (correct, total) counts are allgathered and
+    the returned fraction is the GLOBAL accuracy — identical on every
+    process, and equal to scoring the concatenated dataset on one host.
+    All participating processes must call evaluate() (it contains a
+    collective). Single-process it is a no-op flag.
     """
 
     def __init__(self, prediction_col: str = "prediction",
-                 label_col: str = "label"):
+                 label_col: str = "label", across_processes: bool = False):
         self.prediction_col = prediction_col
         self.label_col = label_col
+        self.across_processes = bool(across_processes)
 
     @staticmethod
     def _to_index(col: np.ndarray, threshold: float = 0.5) -> np.ndarray:
@@ -49,25 +58,53 @@ class AccuracyEvaluator(Evaluator):
     def evaluate(self, dataset: Dataset) -> float:
         pred = self._to_index(dataset[self.prediction_col])
         true = self._to_index(dataset[self.label_col])
-        return float(np.mean(pred == true))
+        correct, total = int(np.sum(pred == true)), len(pred)
+        if self.across_processes:
+            correct, total = _allgather_counts(correct, total)
+        return float(correct / total)
+
+
+def _allgather_counts(value: float, total: int):
+    """Sum (value, total) pairs over processes — the host-sharded
+    aggregation primitive (a tiny collective; every process must call)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return value, total
+    from jax.experimental import multihost_utils
+
+    gathered = np.asarray(multihost_utils.process_allgather(
+        np.array([value, total], np.float64)))
+    return float(gathered[..., 0].sum()), float(gathered[..., 1].sum())
 
 
 class LossEvaluator(Evaluator):
     """Mean loss of a scored dataset (upgrade over the reference, which only
-    ships accuracy; loss names resolve through ops.losses)."""
+    ships accuracy; loss names resolve through ops.losses).
+
+    ``across_processes=True``: same host-sharded contract as
+    AccuracyEvaluator — the local mean is weighted by the local row count
+    and aggregated, so the result equals the single-host mean over the
+    concatenated rows."""
 
     def __init__(self, loss: str = "categorical_crossentropy",
                  prediction_col: str = "prediction",
-                 label_col: str = "label"):
+                 label_col: str = "label", across_processes: bool = False):
         from distkeras_tpu.ops import losses as losses_lib
 
         self.loss_fn = losses_lib.get(loss)
         self.prediction_col = prediction_col
         self.label_col = label_col
+        self.across_processes = bool(across_processes)
 
     def evaluate(self, dataset: Dataset) -> float:
         import jax.numpy as jnp
 
         logits = jnp.asarray(dataset[self.prediction_col])
         labels = jnp.asarray(dataset[self.label_col])
-        return float(self.loss_fn(logits, labels))
+        local = float(self.loss_fn(logits, labels))
+        if self.across_processes:
+            weighted, total = _allgather_counts(local * len(logits),
+                                                len(logits))
+            return float(weighted / total)
+        return local

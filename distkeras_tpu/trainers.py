@@ -181,14 +181,19 @@ class Trainer:
     #: chunked knob (staging_rounds / staging_steps) instead of OOMing
     _RESIDENT_WARN_BYTES = 4 << 30
 
-    def _warn_if_large_resident(self, dataset: Dataset, knob: str):
+    def _resident_bytes(self, dataset: Dataset) -> int:
+        """Estimated host bytes of one epoch's feature+label columns
+        (0 when a column defeats the estimate)."""
         try:
-            total = sum(
+            return sum(
                 np.dtype(dataset[c].dtype).itemsize *
                 int(np.prod(dataset[c].shape))
                 for c in (self.features_col, self.label_col))
         except Exception:
-            return
+            return 0
+
+    def _warn_if_large_resident(self, dataset: Dataset, knob: str):
+        total = self._resident_bytes(dataset)
         if total > self._RESIDENT_WARN_BYTES:
             import warnings
 
@@ -718,13 +723,7 @@ class DistributedTrainer(Trainer):
             # shards are staged host-resident UP FRONT — num_epoch x the
             # local shard bytes. Warn when that estimate is large (the
             # O(chunk) alternative is mode='sync' + staging_rounds).
-            try:
-                per_epoch = sum(
-                    np.dtype(dataset[c].dtype).itemsize
-                    * int(np.prod(dataset[c].shape))
-                    for c in (self.features_col, self.label_col))
-            except Exception:
-                per_epoch = 0
+            per_epoch = self._resident_bytes(dataset)
             if per_epoch * self.num_epoch > self._RESIDENT_WARN_BYTES:
                 import warnings
 
