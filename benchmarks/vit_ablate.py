@@ -1,0 +1,194 @@
+"""Ablation profiler for the ViT-base MFU push (VERDICT r4 ask #3).
+
+Same methodology as profile_ablate.py (ResNet, r3): each case reports
+ms/step and the implied MFU against the FULL baseline model's analytic
+FLOPs — a row answers "what would the baseline's MFU be if this component
+were free". Baseline = step_probe parity: vit_base, batch 64, adamw,
+24-step scans, device-resident data, fetch-synced timing.
+
+Run: python benchmarks/vit_ablate.py [--quick] [--only k1,k2]
+Findings land in DESIGN.md §4c.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+try:
+    import distkeras_tpu  # noqa: F401
+except ImportError:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+# ONE copy of the fetch-synced timing methodology: a drift between the
+# ResNet and ViT profilers would make their A/B numbers non-comparable
+from profile_ablate import sync_via_fetch, timeit  # noqa: E402,F401
+
+BATCH = 64
+SCAN = 24
+
+
+def make_batch(batch_n):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 256, (batch_n, 224, 224, 3),
+                                 dtype=np.uint8))
+    y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch_n)]
+    return {"features": jax.device_put(x),
+            "labels": jax.device_put(jnp.asarray(y))}
+
+
+def build(model, opt_name="adamw", batch_n=BATCH):
+    import optax
+
+    from distkeras_tpu import engine
+
+    tx = {"adamw": optax.adamw(1e-3), "sgd": optax.sgd(0.05),
+          "adafactor": optax.adafactor(1e-3)}[opt_name]
+    sample = {"features": jnp.zeros((batch_n, 224, 224, 3), jnp.uint8)}
+    state = engine.create_train_state(model, jax.random.key(0), sample, tx)
+    grad_fn = engine.make_grad_fn(model, "categorical_crossentropy")
+
+    def step(carry, batch):
+        params, opt_state = carry
+        (_, _), grads = grad_fn(params, batch, None)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        import optax as _o
+
+        return (_o.apply_updates(params, updates), opt_state)
+
+    def run(carry, batch):
+        def body(c, _):
+            return step(c, batch), None
+
+        c, _ = jax.lax.scan(body, carry, None, length=SCAN)
+        return c
+
+    return state, step, jax.jit(run, donate_argnums=(0,))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true")
+    p.add_argument("--only", default="")
+    args = p.parse_args()
+    reps = 2 if args.quick else 3
+    only = set(args.only.split(",")) - {""}
+
+    from distkeras_tpu import observability
+    from distkeras_tpu.models import vit as vit_lib
+
+    peak = observability.device_peak_flops()
+    if peak is None:
+        peak = 197e12
+        print("# WARNING: not on TPU, assuming v5e peak")
+
+    base_model = vit_lib.vit_base()
+    state, step, _ = build(base_model)
+    flops = observability.count_flops(
+        lambda c, b: step(c, b), (state.params, state.opt_state),
+        make_batch(BATCH))
+    print(f"# analytic FLOPs per b{BATCH} step: {flops/1e12:.3f} T "
+          f"(peak {peak/1e12:.0f} T)")
+    del state
+
+    results = {}
+
+    def run_case(key, label, model=None, opt="adamw", batch_n=BATCH,
+                 fwd_only=False):
+        if only and key not in only:
+            return
+        model = model or vit_lib.vit_base()
+        try:
+            st, stp, fn = build(model, opt, batch_n)
+            batch = make_batch(batch_n)
+            if fwd_only:
+                def fwd(c, b):
+                    params, acc = c
+
+                    def body(cc, _):
+                        p, a = cc
+                        out = model.apply({"params": p}, b["features"],
+                                          train=True)
+                        return (p, a + out.astype(jnp.float32).mean()), None
+
+                    cc, _ = jax.lax.scan(body, (params, acc), None,
+                                         length=SCAN)
+                    return cc
+
+                fn = jax.jit(fwd)
+                t = timeit(fn, (st.params, jnp.float32(0)), batch,
+                           reps=reps) / SCAN
+            else:
+                t = timeit(fn, (st.params, st.opt_state), batch,
+                           reps=reps) / SCAN
+        except Exception as e:
+            print(f"{label:46s} FAILED {type(e).__name__}: {e}")
+            return
+        scale = batch_n / BATCH
+        mfu = flops * scale / (t * peak)
+        print(f"{label:46s} {t*1e3:8.2f} ms/step   "
+              f"implied-MFU {mfu*100:5.1f}%")
+        results[key] = t
+
+    run_case("base", "baseline: vit_base b64 adamw")
+    run_case("fwd_only", "forward only", fwd_only=True)
+    run_case("sgd", "optimizer adamw -> sgd", opt="sgd")
+    run_case("b128", "batch 128", batch_n=128)
+    run_case("b256", "batch 256", batch_n=256)
+
+    # LayerNorm -> identity: cost of the fp32 norm chains
+    import flax.linen as nn
+
+    class _Id(nn.Module):
+        dtype: jnp.dtype = jnp.float32
+
+        def __call__(self, x):
+            return x
+
+    orig_ln = nn.LayerNorm
+    import distkeras_tpu.models.transformer as tfm
+
+    tfm.nn.LayerNorm = lambda dtype=jnp.float32, name=None: _Id(name=name)
+    try:
+        run_case("no_ln", "LayerNorm -> identity")
+    finally:
+        tfm.nn.LayerNorm = orig_ln
+
+    # bf16 LayerNorm (normally fp32 by design)
+    tfm.nn.LayerNorm = lambda dtype=jnp.float32, name=None: orig_ln(
+        dtype=jnp.bfloat16, name=name)
+    try:
+        run_case("bf16_ln", "LayerNorm in bf16")
+    finally:
+        tfm.nn.LayerNorm = orig_ln
+
+    # attention -> identity: cost of the attention einsums+softmax
+    from distkeras_tpu.ops import attention as attn_lib
+
+    orig_attn = attn_lib.dot_product_attention
+    attn_lib.dot_product_attention = \
+        lambda q, k, v, mask=None, causal=False: v
+    try:
+        run_case("no_attn", "attention einsums+softmax -> identity")
+    finally:
+        attn_lib.dot_product_attention = orig_attn
+
+    if "base" in results:
+        print("\n# deltas vs baseline:")
+        base = results["base"]
+        for k, v in results.items():
+            if k == "base":
+                continue
+            print(f"  {k:10s} {1e3*(v-base):+8.2f} ms/step "
+                  f"({(v-base)/base*100:+5.1f}%)")
+
+
+if __name__ == "__main__":
+    main()

@@ -283,6 +283,13 @@ def main():
         name, fn = CONFIGS[k]
         try:
             result = fn(args.full, args.marginal)
+            if args.full and k in ("3", "4", "5"):
+                # end-to-end MFU here includes input staging over whatever
+                # host->device link this stack has (tunnel-grade and
+                # unstable between rounds — BASELINE.md); the authoritative
+                # chip-side MFU artifact for these families is step_probe
+                result["authoritative_mfu"] = \
+                    "benchmarks/step_probe.py (see BASELINE.md table)"
             print(json.dumps({"config": k, "name": name,
                               "mode": "full" if args.full else "smoke",
                               **result}))

@@ -60,22 +60,41 @@ class AccuracyEvaluator(Evaluator):
         true = self._to_index(dataset[self.label_col])
         correct, total = int(np.sum(pred == true)), len(pred)
         if self.across_processes:
-            correct, total = _allgather_counts(correct, total)
+            correct, total = _allgather_counts(correct, total,
+                                               integral=True)
+        if total == 0:
+            # empty (local or global) shard: NaN like np.mean([]), never a
+            # ZeroDivisionError — an empty host must not crash the pod
+            return float("nan")
         return float(correct / total)
 
 
-def _allgather_counts(value: float, total: int):
+def _allgather_counts(value: float, total: float, integral: bool = False):
     """Sum (value, total) pairs over processes — the host-sharded
-    aggregation primitive (a tiny collective; every process must call)."""
+    aggregation primitive (a tiny collective; every process must call,
+    with the SAME ``integral`` flag — it picks the wire dtype).
+
+    ``integral=True`` gathers int32 (exact counts: JAX's default x64
+    disable would silently downcast a float64 payload to float32, losing
+    exactness above 2^24). Float payloads (loss sums) ride float32; their
+    ~1e-7 relative rounding is noise next to the loss's own precision."""
     import jax
 
     if jax.process_count() == 1:
         return value, total
     from jax.experimental import multihost_utils
 
-    gathered = np.asarray(multihost_utils.process_allgather(
-        np.array([value, total], np.float64)))
-    return float(gathered[..., 0].sum()), float(gathered[..., 1].sum())
+    if integral:
+        if not (abs(value) < 2 ** 31 and abs(total) < 2 ** 31):
+            raise ValueError(
+                f"per-process counts ({value}, {total}) exceed int32; "
+                f"shard the evaluation further")
+        arr = np.array([int(value), int(total)], np.int32)
+    else:
+        arr = np.array([value, total], np.float32)
+    gathered = np.asarray(multihost_utils.process_allgather(arr))
+    return (float(gathered[..., 0].astype(np.float64).sum()),
+            float(gathered[..., 1].astype(np.float64).sum()))
 
 
 class LossEvaluator(Evaluator):
@@ -83,9 +102,11 @@ class LossEvaluator(Evaluator):
     ships accuracy; loss names resolve through ops.losses).
 
     ``across_processes=True``: same host-sharded contract as
-    AccuracyEvaluator — the local mean is weighted by the local row count
-    and aggregated, so the result equals the single-host mean over the
-    concatenated rows."""
+    AccuracyEvaluator — each host's mean is weighted by its NORMALIZATION
+    unit count (rows for per-row-mean losses; VALID TOKENS for
+    ``masked_lm``, which normalizes by unmasked positions) and
+    aggregated, so the result equals the single-host mean over the
+    concatenated rows for both families."""
 
     def __init__(self, loss: str = "categorical_crossentropy",
                  prediction_col: str = "prediction",
@@ -93,18 +114,27 @@ class LossEvaluator(Evaluator):
         from distkeras_tpu.ops import losses as losses_lib
 
         self.loss_fn = losses_lib.get(loss)
+        self._loss_name = loss if isinstance(loss, str) else None
         self.prediction_col = prediction_col
         self.label_col = label_col
         self.across_processes = bool(across_processes)
+
+    def _weight(self, labels) -> int:
+        """How many units the loss's own mean divides by locally."""
+        if self._loss_name == "masked_lm":
+            return int(np.sum(np.asarray(labels) >= 0))
+        return len(labels)
 
     def evaluate(self, dataset: Dataset) -> float:
         import jax.numpy as jnp
 
         logits = jnp.asarray(dataset[self.prediction_col])
         labels = jnp.asarray(dataset[self.label_col])
-        local = float(self.loss_fn(logits, labels))
+        weight = self._weight(labels)
+        # an empty local shard contributes (0, 0) — NaN must not enter the
+        # collective and poison every process's global loss
+        local = float(self.loss_fn(logits, labels)) if weight else 0.0
         if self.across_processes:
-            weighted, total = _allgather_counts(local * len(logits),
-                                                len(logits))
-            return float(weighted / total)
-        return local
+            weighted, total = _allgather_counts(local * weight, weight)
+            return float(weighted / total) if total else float("nan")
+        return local if weight else float("nan")

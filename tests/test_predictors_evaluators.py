@@ -102,3 +102,38 @@ def test_model_predictor_preserves_integer_token_ids():
     direct = model.apply({"params": params}, jnp.asarray(ids))
     np.testing.assert_allclose(out["prediction"], np.asarray(direct),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_loss_evaluator_masked_lm_weight_counts_valid_tokens():
+    """Cross-process aggregation weights must match the loss's OWN
+    normalization: masked_lm divides by valid (label >= 0) tokens, not
+    rows — a row-weighted merge would misweight uneven hosts."""
+    from distkeras_tpu.evaluators import LossEvaluator
+
+    ev = LossEvaluator(loss="masked_lm")
+    labels = np.array([[1, -1, 3], [-1, -1, -1]], np.int32)
+    assert ev._weight(labels) == 2  # 2 valid tokens, not 2 rows x 3
+    assert LossEvaluator()._weight(labels) == 2  # rows for per-row losses
+
+
+def test_evaluators_empty_dataset_is_nan_not_crash():
+    """An empty host shard returns NaN (np.mean([]) semantics), never a
+    ZeroDivisionError — and contributes (0, 0) to the global aggregation
+    instead of poisoning it with NaN."""
+    from distkeras_tpu.evaluators import AccuracyEvaluator, LossEvaluator
+
+    empty = Dataset({"prediction": np.zeros((0, 4), np.float32),
+                     "label": np.zeros((0, 4), np.float32)})
+    assert np.isnan(AccuracyEvaluator().evaluate(empty))
+    assert np.isnan(LossEvaluator().evaluate(empty))
+    # single-process across_processes degenerates but must not divide by 0
+    assert np.isnan(AccuracyEvaluator(across_processes=True).evaluate(empty))
+    assert np.isnan(LossEvaluator(across_processes=True).evaluate(empty))
+
+
+def test_allgather_counts_integral_guard():
+    from distkeras_tpu.evaluators import _allgather_counts
+
+    # single-process: pass-through, no collective
+    assert _allgather_counts(3, 7, integral=True) == (3, 7)
+    assert _allgather_counts(1.5, 2.0) == (1.5, 2.0)
