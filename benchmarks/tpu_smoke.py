@@ -44,8 +44,6 @@ PROBE_FLOORS = {"resnet": 0.51, "bert": 0.55, "vit": 0.48}
 
 def perf_checks() -> int:
     """Calibration gate + per-family probe floors. Returns failure count."""
-    import jax
-
     from distkeras_tpu import observability
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

@@ -114,14 +114,17 @@ class LossEvaluator(Evaluator):
         from distkeras_tpu.ops import losses as losses_lib
 
         self.loss_fn = losses_lib.get(loss)
-        self._loss_name = loss if isinstance(loss, str) else None
+        # identity check, not the ctor string: losses.get passes callables
+        # through, and a caller handing the masked_lm FUNCTION must get
+        # token weighting too
+        self._is_masked_lm = self.loss_fn is losses_lib.masked_lm
         self.prediction_col = prediction_col
         self.label_col = label_col
         self.across_processes = bool(across_processes)
 
     def _weight(self, labels) -> int:
         """How many units the loss's own mean divides by locally."""
-        if self._loss_name == "masked_lm":
+        if self._is_masked_lm:
             return int(np.sum(np.asarray(labels) >= 0))
         return len(labels)
 

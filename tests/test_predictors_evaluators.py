@@ -131,9 +131,24 @@ def test_evaluators_empty_dataset_is_nan_not_crash():
     assert np.isnan(LossEvaluator(across_processes=True).evaluate(empty))
 
 
-def test_allgather_counts_integral_guard():
+def test_allgather_counts_integral_guard(monkeypatch):
+    import jax as _jax
+    import pytest
+    from jax.experimental import multihost_utils
+
     from distkeras_tpu.evaluators import _allgather_counts
 
     # single-process: pass-through, no collective
     assert _allgather_counts(3, 7, integral=True) == (3, 7)
     assert _allgather_counts(1.5, 2.0) == (1.5, 2.0)
+
+    # fake a 2-process world and intercept the gather: the int32 bound
+    # must be validated BEFORE any collective, and the summed result must
+    # come back exact
+    monkeypatch.setattr(_jax, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost_utils, "process_allgather",
+                        lambda arr: np.stack([arr, arr]))
+    with pytest.raises(ValueError, match="int32"):
+        _allgather_counts(2 ** 40, 7, integral=True)
+    assert _allgather_counts(3, 7, integral=True) == (6, 14)
+    assert _allgather_counts(1.5, 2.0) == (3.0, 4.0)
