@@ -30,13 +30,28 @@ class Checkpointer:
         state = ckpt.restore(like=state) # latest, or step=N for a specific one
     """
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 local_host_only: bool = False):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
+        kwargs = dict(max_to_keep=max_to_keep, create=True)
+        if local_host_only:
+            # Single-controller checkpointing in a multi-process world:
+            # Orbax's save/restore otherwise runs cross-process barriers
+            # that DEADLOCK when only this process owns the checkpoint
+            # (e.g. the cross-process host_async center lives on process 0
+            # alone; its saver thread fires at arbitrary times no peer
+            # could rendezvous with).
+            kwargs["multiprocessing_options"] = \
+                ocp.options.MultiprocessingOptions(
+                    primary_host=jax.process_index(),
+                    active_processes={jax.process_index()})
+            # create=True is unsupported with active_processes; the
+            # makedirs above already created the root
+            kwargs["create"] = False
         self._mgr = ocp.CheckpointManager(
             self.directory,
-            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
-                                                 create=True),
+            options=ocp.CheckpointManagerOptions(**kwargs),
             # declare the handler up front: metadata() must be able to read
             # a step's shapes in a FRESH manager that has neither saved nor
             # restored yet (elastic-resume topology probe)

@@ -84,12 +84,13 @@ class Trainer:
         self.training_time: float = 0.0
 
     # -- checkpointing (per-epoch; the reference had NONE — SURVEY.md §5) ---
-    def _checkpointer(self):
+    def _checkpointer(self, local_host_only: bool = False):
         if self.checkpoint_dir is None:
             return None
         from distkeras_tpu.checkpoint import Checkpointer
 
-        return Checkpointer(self.checkpoint_dir)
+        return Checkpointer(self.checkpoint_dir,
+                            local_host_only=local_host_only)
 
     @staticmethod
     def _check_fresh_dir(ckpt) -> None:
@@ -700,17 +701,42 @@ class DistributedTrainer(Trainer):
 
         state = self._init_params(dataset)
         init_params, start_clock = state.params, 0
-        ckpt = self._checkpointer() if (not multi or pid == 0) else None
-        if ckpt is not None:
+        # process 0 alone owns the live center's snapshots; Orbax must not
+        # expect its peers at any barrier (local_host_only)
+        ckpt, ckpt_error = None, None
+        if not multi or pid == 0:
             try:
-                snap, _ = self._maybe_resume(
-                    ckpt, {"center": init_params,
-                           "clock": np.zeros((1,), np.int64)}, resume)
-            except BaseException:
-                ckpt.close()
-                raise
-            init_params = snap["center"]
-            start_clock = int(np.asarray(snap["clock"])[0])
+                ckpt = self._checkpointer(local_host_only=multi)
+                if ckpt is not None:
+                    try:
+                        snap, _ = self._maybe_resume(
+                            ckpt, {"center": init_params,
+                                   "clock": np.zeros((1,), np.int64)},
+                            resume)
+                    except BaseException:
+                        ckpt.close()
+                        raise
+                    init_params = snap["center"]
+                    start_clock = int(np.asarray(snap["clock"])[0])
+            except BaseException as e:
+                if not multi:
+                    raise
+                ckpt_error = e  # defer: the peers must hear first
+        if multi:
+            # Checkpoint state is process-0-private, so a one-sided raise
+            # (stale dir with resume=False, corrupt restore) would leave
+            # the peers hanging in share_service_address's broadcast;
+            # agree on go/no-go symmetrically before any collective.
+            from jax.experimental import multihost_utils
+
+            flags = np.asarray(multihost_utils.process_allgather(
+                np.int64(0 if ckpt_error is None else 1))).ravel()
+            if flags.any():
+                if ckpt_error is not None:
+                    raise ckpt_error
+                raise ValueError(
+                    f"checkpoint setup failed on process(es) "
+                    f"{np.flatnonzero(flags).tolist()}; see their logs")
 
         def ds_for(e):
             ds = provider.epoch_dataset(e) if provider is not None \

@@ -644,6 +644,93 @@ def test_two_process_host_sharded_inference_matches_oracle(tmp_path):
     assert vals["0"][1] != vals["1"][1] or vals["0"][0] != vals["1"][0]
 
 
+ASYNC_RESUME_WORKER = textwrap.dedent("""
+    import os, sys
+    pid = int(sys.argv[1]); port = sys.argv[2]; repo = sys.argv[3]
+    phase = os.environ["AR_PHASE"]; ckdir = os.environ["AR_CKDIR"]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    sys.path.insert(0, repo)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from distkeras_tpu.parallel import distributed
+    distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=2, process_id=pid)
+    import numpy as np
+    from distkeras_tpu import ADAG
+    from distkeras_tpu.data import Dataset, synthetic_mnist
+    from distkeras_tpu.models.mlp import MLP
+
+    full = synthetic_mnist(n=1024)
+    lo, hi = (0, 512) if pid == 0 else (512, 1024)
+    ds_local = Dataset({c: np.asarray(full[c])[lo:hi]
+                        for c in full.columns})
+    t = ADAG(MLP(features=(32,), dropout_rate=0.0), worker_optimizer="sgd",
+             learning_rate=0.05, metrics=(), batch_size=16,
+             communication_window=2, num_epoch=2, num_workers=4,
+             mode="host_async", data_layout="host_sharded",
+             checkpoint_dir=ckdir, checkpoint_folds=8)
+    if phase == "stale":
+        # stale non-empty dir + resume=False: process 0's private
+        # checkpoint error must reach EVERY process (symmetric raise),
+        # not leave the peers hanging in the service-address broadcast
+        try:
+            t.train(ds_local)
+        except ValueError as e:
+            assert ("resume=True" in str(e)) or ("see their logs" in str(e))
+            print(f"RESUMEOK phase=stale proc={pid} updates=-1 h0=0.0")
+            sys.exit(0)
+        raise AssertionError("stale checkpoint dir was not rejected")
+    t.train(ds_local, resume=(phase == "2"))
+    print(f"RESUMEOK phase={phase} proc={pid} updates={t.num_updates} "
+          f"h0={t.history[0]['loss']:.4f}")
+""")
+
+
+def test_two_process_host_async_resume(tmp_path):
+    """Pod-scale async fault story: a completed two-process live-center run
+    leaves snapshots on process 0; a second two-process run with
+    resume=True restores the center, CONTINUES the commit clock, and
+    starts from the trained state (first losses far below a fresh init)."""
+    import os
+    import re
+
+    ckdir = str(tmp_path / "ck")
+    os.environ["AR_CKDIR"] = ckdir
+
+    def run_phase(phase):
+        os.environ["AR_PHASE"] = phase
+        try:
+            outs = _run_two_procs(tmp_path, ASYNC_RESUME_WORKER,
+                                  timeout=300)
+        finally:
+            del os.environ["AR_PHASE"]
+        vals = {}
+        for out in outs:
+            m = re.search(r"RESUMEOK phase=(\w+) proc=(\d) "
+                          r"updates=(-?\d+) h0=([\d.]+)", out)
+            assert m, out[-2000:]
+            vals[m.group(2)] = (int(m.group(3)), float(m.group(4)))
+        assert vals["0"] == vals["1"]  # merged result identical
+        return vals["0"]
+
+    try:
+        up1, h0_1 = run_phase("1")
+        # 4 workers x 8 rounds/epoch x 2 epochs
+        assert up1 == 64
+        up2, h0_2 = run_phase("2")
+        # stale dir + resume=False: BOTH processes raise cleanly (the
+        # worker exits 0 only after catching the expected ValueError)
+        run_phase("stale")
+    finally:
+        del os.environ["AR_CKDIR"]
+    # the clock CONTINUED from the restored snapshot
+    assert up2 == 128
+    # phase 2 started from the TRAINED center, not a fresh init (~2.5)
+    assert h0_2 < h0_1 - 0.3
+
+
 def test_two_process_full_trainer_matches_single_process(tmp_path):
     """The PUBLIC ADAG trainer — staging, epochs, metric recording, final
     param fetch — runs unchanged on a two-process mesh and reproduces the
