@@ -7,7 +7,7 @@ jax/XLA: jit-compiled update steps, mesh-sharded replicas, and ICI collectives
 instead of TCP+pickle. See SURVEY.md for the layer-by-layer mapping.
 """
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 from distkeras_tpu.data.dataset import Dataset, synthetic_mnist
 from distkeras_tpu.evaluators import AccuracyEvaluator, Evaluator, LossEvaluator

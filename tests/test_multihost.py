@@ -503,20 +503,11 @@ def test_two_process_global_shards_mixes_across_hosts(tmp_path):
     differs from its epoch-0 set while each epoch's global multiset is the
     whole pool; the two-process trajectory equals the single-process
     oracle over the same (identically permuted) pool."""
-    import os
     import re
 
     import numpy as np
 
-    rng = np.random.default_rng(7)
-    pool = tmp_path / "pool"
-    pool.mkdir()
-    for i in range(8):
-        np.save(pool / f"f{i}.npy",
-                rng.standard_normal((64, 784)).astype(np.float32))
-        np.save(pool / f"l{i}.npy",
-                np.eye(10, dtype=np.float32)[rng.integers(0, 10, 64)])
-    os.environ["GS_POOL_DIR"] = str(pool)
+    pool = _make_shard_pool(tmp_path, seed=7)
     try:
         outs = _run_two_procs(tmp_path, GLOBAL_SHARDS_WORKER, timeout=300)
     finally:
@@ -642,6 +633,87 @@ def test_two_process_host_sharded_inference_matches_oracle(tmp_path):
         np.testing.assert_allclose(loss_global, loss_ref, atol=1e-5)
     # the halves genuinely differ locally (so the aggregation is real)
     assert vals["0"][1] != vals["1"][1] or vals["0"][0] != vals["1"][0]
+
+
+def _make_shard_pool(tmp_path, seed: int):
+    """8 shard files x 64 rows under tmp_path/pool; exported to workers
+    via GS_POOL_DIR. Returns the pool path (caller deletes the env var)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    pool = tmp_path / "pool"
+    pool.mkdir()
+    for i in range(8):
+        np.save(pool / f"f{i}.npy",
+                rng.standard_normal((64, 784)).astype(np.float32))
+        np.save(pool / f"l{i}.npy",
+                np.eye(10, dtype=np.float32)[rng.integers(0, 10, 64)])
+    os.environ["GS_POOL_DIR"] = str(pool)
+    return pool
+
+
+GS_ASYNC_WORKER = textwrap.dedent("""
+    import os, sys
+    pid = int(sys.argv[1]); port = sys.argv[2]; repo = sys.argv[3]
+    pool_dir = os.environ["GS_POOL_DIR"]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    sys.path.insert(0, repo)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from distkeras_tpu.parallel import distributed
+    distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=2, process_id=pid)
+    import numpy as np
+    from distkeras_tpu import ADAG
+    from distkeras_tpu.data import GlobalShards
+    from distkeras_tpu.models.mlp import MLP
+
+    gs = GlobalShards({
+        "features": [os.path.join(pool_dir, f"f{i}.npy") for i in range(8)],
+        "label": [os.path.join(pool_dir, f"l{i}.npy") for i in range(8)],
+    }, seed=9)
+    a = [gs.epoch_assignment(e) for e in (0, 1)]
+    t = ADAG(MLP(features=(32,), dropout_rate=0.0), worker_optimizer="sgd",
+             learning_rate=0.05, metrics=(), batch_size=16,
+             communication_window=2, num_epoch=2, num_workers=4,
+             mode="host_async", data_layout="host_sharded")
+    t.train(gs)
+    checksum = float(sum(np.abs(np.asarray(l)).sum()
+                         for l in jax.tree.leaves(t.params)))
+    redealt = int(set(a[0][pid]) != set(a[1][pid]))
+    union_ok = int(sorted(a[0][0] + a[0][1]) == list(range(8)) and
+                   sorted(a[1][0] + a[1][1]) == list(range(8)))
+    print(f"GSASYNC proc={pid} updates={t.num_updates} "
+          f"redealt={redealt} union={union_ok} checksum={checksum:.6f}")
+""")
+
+
+def test_two_process_global_shards_with_live_center(tmp_path):
+    """GlobalShards x host_async x two processes: shard files re-deal to
+    hosts per epoch WHILE worker threads commit to process 0's live
+    center; both compositions' invariants hold at once."""
+    import re
+
+    _make_shard_pool(tmp_path, seed=11)
+    try:
+        outs = _run_two_procs(tmp_path, GS_ASYNC_WORKER, timeout=300)
+    finally:
+        del os.environ["GS_POOL_DIR"]
+    vals = {}
+    for out in outs:
+        m = re.search(r"GSASYNC proc=(\d) updates=(\d+) redealt=(\d) "
+                      r"union=(\d) checksum=([\d.]+)", out)
+        assert m, out[-2000:]
+        vals[m.group(1)] = tuple(float(x) for x in m.groups()[1:])
+    # merged result identical on both processes (live-center contract)
+    assert vals["0"] == vals["1"]
+    updates, redealt, union_ok, _ = vals["0"]
+    # 4 workers x 4 rounds/epoch x 2 epochs against ONE center
+    assert updates == 32
+    # host 0's shard set changed between epochs; pool preserved per epoch
+    assert redealt == 1 and union_ok == 1
 
 
 ASYNC_RESUME_WORKER = textwrap.dedent("""
