@@ -147,3 +147,47 @@ def test_unknown_op_is_rejected():
         cli.close()
     finally:
         svc.stop()
+
+
+def test_dead_service_mid_run_raises_cleanly():
+    """The cross-process fault contract: when the service dies mid-run
+    (process 0 crashed), workers' next pull/commit raises a socket error,
+    the runner's fail-fast abort stops the siblings, and run() raises —
+    it must NOT hang (the reference analogue: executors erroring out when
+    the driver's PS socket goes away)."""
+    import jax.numpy as jnp
+
+    from distkeras_tpu.data.dataset import synthetic_mnist
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.ops import optimizers as opt_lib
+    from distkeras_tpu.parallel import host_async, strategies
+
+    model = MLP(features=(8,), dropout_rate=0.0)
+    tx = opt_lib.get("sgd", 0.05)
+    strat = strategies.get("adag", learning_rate=0.05)
+    params = model.init(jax.random.key(0), jnp.zeros((4, 784)),
+                        train=False)["params"]
+    ps = DeltaParameterServer(jax.device_put(params))
+    svc = ParameterServerService(ps, params, expected_processes=1)
+    svc.start()
+    cli = RemoteParameterServer(f"127.0.0.1:{svc.port}", params)
+
+    killed = threading.Event()
+    orig_commit = cli.commit
+
+    def commit_then_die(delta, last_update=0):
+        out = orig_commit(delta, last_update=last_update)
+        if not killed.is_set():
+            killed.set()
+            svc.stop()
+            cli._sock.close()  # the wire is gone, like a dead process 0
+        return out
+
+    cli.commit = commit_then_die
+    runner = host_async.HostAsyncRunner(
+        model, "categorical_crossentropy", tx, strat, window=2)
+    shards = host_async.stage_worker_shards(
+        synthetic_mnist(n=512).repartition(2), "features", "label", 4, 2)
+    with pytest.raises(OSError):
+        runner.run(params, [shards] * 3, ps=cli, fetch_final=False)
+    assert killed.is_set()
