@@ -8,10 +8,13 @@ This probe is the other bound: ONE jitted scan of train steps on
 device-resident data — no staging in the timed window at all — giving the
 compute ceiling the trainer harness should approach on a real TPU host.
 
-Usage: python benchmarks/step_probe.py [vit|resnet|bert|all] [--batch N]
+Usage: python benchmarks/step_probe.py [vit|resnet|bert|cnn|gpt|all]
+       [--batch N] [--steps N]
 Prints one JSON line per model with samples/s and MFU (fetch-synced timing,
 analytic FLOPs — same methodology as bench.py, validated by
-observability.calibrate_peak).
+observability.calibrate_peak). When --batch/--steps are not given, each
+family uses its CANONICAL settings (the ones its BASELINE.md floor is
+defined at — e.g. resnet needs batch 128, gpt OOMs above batch 8).
 """
 
 from __future__ import annotations
@@ -59,6 +62,30 @@ def probe(name: str, batch: int, steps: int = 8) -> dict:
         rng = np.random.default_rng(0)
         x = rng.integers(1, model.vocab_size, (batch, 128)).astype(np.int16)
         y = np.where(rng.random((batch, 128)) < 0.15, x, -1).astype(np.int16)
+    elif name == "cnn":
+        # BASELINE config 2's family (CIFAR CNN): a small model whose MFU
+        # ceiling is its shapes, not the harness — probe for completeness
+        from distkeras_tpu.models import cifar10_cnn
+
+        model, loss = (cifar10_cnn(dtype=jnp.bfloat16),
+                       "categorical_crossentropy")
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((batch, 32, 32, 3)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
+    elif name == "gpt":
+        # long-context chip-side artifact: GPT-2-small shapes at seq 2048
+        # on the fused pallas flash path (single-chip complement of the
+        # cross-chip ring attention)
+        from distkeras_tpu.models.gpt import CausalLM
+
+        model = CausalLM(vocab_size=50304, max_len=2048, num_layers=12,
+                         num_heads=12, width=768, mlp_dim=3072,
+                         attention="flash")
+        loss = "masked_lm"
+        rng = np.random.default_rng(0)
+        x = rng.integers(1, model.vocab_size, (batch, 2048)).astype(np.int32)
+        y = np.concatenate([x[:, 1:], np.full((batch, 1), -1, np.int32)],
+                           axis=1)
     else:
         raise ValueError(f"unknown model {name!r}")
 
@@ -100,19 +127,34 @@ def probe(name: str, batch: int, steps: int = 8) -> dict:
     return out
 
 
+#: canonical per-family settings — the shapes each family's BASELINE.md
+#: floor is defined at (resnet's MXU sweet spot is b128; gpt OOMs above
+#: b8 at seq 2048). CLI --batch/--steps override.
+CANONICAL = {"vit": dict(batch=64, steps=96),
+             "resnet": dict(batch=128, steps=96),
+             "bert": dict(batch=64, steps=96),
+             "cnn": dict(batch=512, steps=96),
+             "gpt": dict(batch=8, steps=24)}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("which", nargs="?", default="all",
-                    choices=["vit", "resnet", "bert", "all"])
-    ap.add_argument("--batch", type=int, default=64)
-    ap.add_argument("--steps", type=int, default=24,
+                    choices=list(CANONICAL) + ["all"])
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--steps", type=int, default=None,
                     help="scanned steps per timed device call; keep the "
                          "call >=1s so the ~90ms tunnel dispatch is noise")
     args = ap.parse_args()
-    names = ["vit", "resnet", "bert"] if args.which == "all" else [args.which]
+    names = list(CANONICAL) if args.which == "all" else [args.which]
     for name in names:
+        cfg = dict(CANONICAL[name])
+        if args.batch is not None:
+            cfg["batch"] = args.batch
+        if args.steps is not None:
+            cfg["steps"] = args.steps
         try:
-            print(json.dumps(probe(name, args.batch, steps=args.steps)))
+            print(json.dumps(probe(name, cfg["batch"], steps=cfg["steps"])))
         except Exception as e:
             print(json.dumps({"model": name,
                               "error": f"{type(e).__name__}: {e}"}))

@@ -36,10 +36,14 @@ except ImportError:  # running from a source checkout: use the repo root
 #: values so real regressions fail while noise passes:
 #: resnet 53.5 -> 0.51; bert 57.9 -> 0.55; vit 50.9 -> 0.48 (vit's
 #: measured device-op floor is 51.8% at its shapes — DESIGN.md §4c).
-PROBE_SETTINGS = {"resnet": dict(batch=128, steps=96),
-                  "bert": dict(batch=64, steps=96),
-                  "vit": dict(batch=64, steps=96)}
-PROBE_FLOORS = {"resnet": 0.51, "bert": 0.55, "vit": 0.48}
+#: cnn (config 2's family, b512): measured 40.6% -> floor 0.38. gpt
+#: (GPT-2-small @ seq 2048 on the pallas flash path, b8): bandwidth-bound
+#: by the fp32 50k-vocab head + LM loss at small batch; its meaning is
+#: capability: XLA full attention cannot even COMPILE this config on v5e
+#: (compiler OOM), b16 OOMs at runtime; flash is the long-context
+#: enabler. Settings come from step_probe.CANONICAL (one copy).
+PROBE_FLOORS = {"resnet": 0.51, "bert": 0.55, "vit": 0.48,
+                "cnn": 0.38, "gpt": 0.17}
 
 
 def perf_checks() -> int:
@@ -47,6 +51,7 @@ def perf_checks() -> int:
     from distkeras_tpu import observability
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from step_probe import CANONICAL as PROBE_SETTINGS
     from step_probe import probe
 
     failures = 0

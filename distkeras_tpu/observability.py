@@ -93,6 +93,14 @@ def _jaxpr_flops(jaxpr) -> float:
             total += _jaxpr_flops(eqn.params["body_jaxpr"])  # >=1 iteration
         elif name == "cond":
             total += max(_jaxpr_flops(b) for b in eqn.params["branches"])
+        elif name == "pallas_call":
+            # the kernel body jaxpr is ONE grid cell's work; the kernel
+            # executes it per cell (counting it once undercounted the
+            # flash-attention probe's matmul FLOPs ~4x per head-batch)
+            cells = 1
+            for g in getattr(eqn.params.get("grid_mapping"), "grid", ()):
+                cells *= int(g)
+            total += cells * _jaxpr_flops(eqn.params["jaxpr"])
         elif "jaxpr" in eqn.params:  # pjit, shard_map, closed_call, remat...
             total += _jaxpr_flops(eqn.params["jaxpr"])
         elif "call_jaxpr" in eqn.params:  # custom_jvp/vjp, xla_call

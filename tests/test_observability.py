@@ -91,3 +91,31 @@ def test_step_timer():
     with t.measure(4):
         pass
     assert t.mean_step_s >= 0 and t.steps == 4
+
+
+def test_pallas_call_flops_scale_with_grid():
+    """A pallas kernel's body jaxpr is ONE grid cell's work; the counter
+    must multiply by the grid size (counting it once undercounted the
+    flash-attention probe ~4x per head-batch — BASELINE.md gpt row)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from distkeras_tpu import observability
+
+    def kernel(x_ref, y_ref, o_ref):
+        o_ref[...] = jnp.dot(x_ref[...], y_ref[...])
+
+    def f(x, y):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((128, 128), jnp.float32),
+            grid=(4,),
+            in_specs=[pl.BlockSpec((128, 128), lambda i: (0, 0)),
+                      pl.BlockSpec((128, 128), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec((128, 128), lambda i: (0, 0)),
+        )(x, y)
+
+    x = jnp.ones((128, 128), jnp.float32)
+    flops = observability.count_flops(f, x, x)
+    assert flops == 4 * 2 * 128 ** 3  # grid cells x 2*MACs per cell
