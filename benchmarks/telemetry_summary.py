@@ -1,0 +1,139 @@
+"""Render a telemetry JSONL artifact into the staleness/latency tables.
+
+Sibling of trace_summary.py: that tool digests the *compute*-side Chrome
+trace; this one digests the *system*-side artifact the telemetry layer
+leaves next to BENCH_*.json (``Trainer(telemetry_path=...)`` or
+``trainer.dump_telemetry(path)``). The headline sections — per-commit
+staleness distribution, PS commit/pull counts, per-worker window
+durations, prefetch queue occupancy — are exactly what a STALENESS_r*
+round cites.
+
+Usage:
+  python benchmarks/telemetry_summary.py <run.telemetry.jsonl> [--top N]
+
+No third-party deps: the artifact is plain JSON lines (schema in
+distkeras_tpu/telemetry.py and DESIGN.md §5b).
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import os
+import sys
+
+try:
+    import distkeras_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # running from a source checkout: use the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def load_rows(path: str) -> list:
+    from distkeras_tpu.telemetry import load_jsonl
+
+    return load_jsonl(path)
+
+
+def _full_name(row: dict) -> str:
+    labels = row.get("labels") or {}
+    if not labels:
+        return row["name"]
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{row['name']}{{{inner}}}"
+
+
+def _fmt(v, unit_s: bool) -> str:
+    if v is None:
+        return "-"
+    if unit_s:  # durations print in ms
+        return f"{v * 1e3:.3f}"
+    return f"{v:.6g}"
+
+
+def summarize(rows: list, top: int = 20) -> str:
+    """The whole report as one string (printed by main, asserted by tests)."""
+    counters = [r for r in rows if r.get("kind") == "counter"]
+    gauges = [r for r in rows if r.get("kind") == "gauge"]
+    hists = [r for r in rows if r.get("kind") == "histogram"]
+    spans = [r for r in rows if r.get("kind") == "span"]
+    meta = next((r for r in rows if r.get("kind") == "meta"), {})
+
+    out = []
+    out.append(f"# telemetry summary (schema {meta.get('schema', '?')}; "
+               f"{len(counters)} counters, {len(gauges)} gauges, "
+               f"{len(hists)} histograms, {len(spans)} span events)")
+
+    if counters:
+        out.append("\n## counters")
+        width = max(len(_full_name(r)) for r in counters)
+        for r in sorted(counters, key=_full_name):
+            out.append(f"{_full_name(r):{width}s}  {r['value']}")
+
+    if gauges:
+        out.append("\n## gauges")
+        width = max(len(_full_name(r)) for r in gauges)
+        for r in sorted(gauges, key=_full_name):
+            out.append(f"{_full_name(r):{width}s}  {r['value']:g}")
+
+    if hists:
+        out.append("\n## histograms  (durations in ms; counts/values raw)")
+        width = max(len(_full_name(r)) for r in hists)
+        out.append(f"{'name':{width}s} {'count':>8s} {'p50':>10s} "
+                   f"{'p95':>10s} {'max':>10s} {'mean':>10s}")
+        for r in sorted(hists, key=_full_name):
+            secs = r["name"].endswith("_s")
+            mean = (r["sum"] / r["count"]) if r["count"] else None
+            out.append(
+                f"{_full_name(r):{width}s} {r['count']:8d} "
+                f"{_fmt(r['p50'], secs):>10s} {_fmt(r['p95'], secs):>10s} "
+                f"{_fmt(r['max'], secs):>10s} {_fmt(mean, secs):>10s}")
+
+    # the headline table: staleness actually experienced at the center
+    stal = [r for r in hists if r["name"] == "ps.commit.staleness"
+            and r["count"]]
+    if stal:
+        out.append("\n## staleness (commits folded between pull and fold)")
+        for r in stal:
+            out.append(f"commits {r['count']}  p50 {r['p50']:g}  "
+                       f"p95 {r['p95']:g}  max {r['max']:g}  "
+                       f"mean {r['sum'] / r['count']:.2f}")
+
+    if spans:
+        out.append(f"\n## spans (top {top} by total duration)")
+        agg = collections.defaultdict(lambda: [0, 0.0])
+        for r in spans:
+            a = agg[_full_name(r)]
+            a[0] += 1
+            a[1] += r["dur_s"]
+        width = max(len(k) for k in agg)
+        out.append(f"{'name':{width}s} {'count':>7s} {'total_ms':>11s}")
+        for name, (n, tot) in sorted(agg.items(),
+                                     key=lambda kv: -kv[1][1])[:top]:
+            out.append(f"{name:{width}s} {n:7d} {tot * 1e3:11.3f}")
+
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="summarize a distkeras_tpu telemetry JSONL artifact")
+    ap.add_argument("path", help="telemetry .jsonl written by "
+                    "Trainer(telemetry_path=...) / dump_telemetry()")
+    ap.add_argument("--top", type=int, default=20,
+                    help="span rows to show (default 20)")
+    args = ap.parse_args(argv)
+    try:
+        rows = load_rows(args.path)
+    except OSError as e:
+        sys.exit(f"cannot read {args.path}: {e}")
+    if not rows:
+        sys.exit(f"{args.path}: empty artifact")
+    try:
+        print(summarize(rows, top=args.top))
+    except BrokenPipeError:  # e.g. `... | head`: exit quietly
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+
+
+if __name__ == "__main__":
+    main()

@@ -7,8 +7,9 @@ jax/XLA: jit-compiled update steps, mesh-sharded replicas, and ICI collectives
 instead of TCP+pickle. See SURVEY.md for the layer-by-layer mapping.
 """
 
-__version__ = "0.5.0"
+__version__ = "0.6.0"
 
+from distkeras_tpu import telemetry
 from distkeras_tpu.data.dataset import Dataset, synthetic_mnist
 from distkeras_tpu.evaluators import AccuracyEvaluator, Evaluator, LossEvaluator
 from distkeras_tpu.predictors import ModelClassifier, ModelPredictor, Predictor
@@ -62,5 +63,6 @@ __all__ = [
     "Trainer",
     "Transformer",
     "synthetic_mnist",
+    "telemetry",
     "__version__",
 ]

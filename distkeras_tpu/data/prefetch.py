@@ -13,7 +13,10 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterable, Iterator, TypeVar
+
+from distkeras_tpu import telemetry
 
 T = TypeVar("T")
 
@@ -36,12 +39,21 @@ def prefetch(it: Iterable[T], depth: int = 1) -> Iterator[T]:
         raise ValueError(f"depth must be >= 1, got {depth}")
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     abandoned = threading.Event()
+    # queue occupancy seen by the consumer: persistently 0 = producer-bound
+    # (disk/staging is the bottleneck), persistently `depth` = device-bound
+    depth_gauge = telemetry.gauge("data.prefetch.queue_depth")
+    depth_hist = telemetry.histogram("data.prefetch.queue_depth_samples")
+    wait_hist = telemetry.histogram("data.prefetch.producer_wait_s")
 
     def _put(item) -> bool:
         """put that gives up when the consumer is gone."""
+        t0 = time.perf_counter()
         while not abandoned.is_set():
             try:
                 q.put(item, timeout=0.1)
+                # time the producer sat blocked on a full queue (plus one
+                # enqueue): the backpressure the bounded buffer applies
+                wait_hist.record(time.perf_counter() - t0)
                 return True
             except queue.Full:
                 continue
@@ -62,6 +74,9 @@ def prefetch(it: Iterable[T], depth: int = 1) -> Iterator[T]:
     thread.start()
     try:
         while True:
+            size = q.qsize()
+            depth_gauge.set(size)
+            depth_hist.record(size)
             is_err, item = q.get()
             if is_err:
                 raise item

@@ -15,6 +15,8 @@ from typing import Callable, Optional
 
 import jax
 
+from distkeras_tpu import telemetry
+
 # Peak dense bf16 FLOP/s per chip, by TPU generation. Public figures:
 # v2 45T, v3 123T, v4 275T, v5e ("v5 lite") 197T, v5p 459T, v6e 918T.
 PEAK_FLOPS_BF16 = {
@@ -40,9 +42,15 @@ def device_peak_flops(device: Optional[jax.Device] = None) -> Optional[float]:
     return None
 
 
+_cost_analysis_noted = False
+
+
 def compiled_flops(compiled) -> Optional[float]:
     """FLOPs of one invocation of a compiled computation, per XLA's own cost
-    analysis. Returns None when the backend doesn't report it."""
+    analysis. Returns None when the backend doesn't report it — and records
+    that fact once per process (``observability.cost_analysis_unavailable``)
+    instead of silently swallowing every failure."""
+    global _cost_analysis_noted
     try:
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):  # older jax returned [dict]
@@ -50,6 +58,10 @@ def compiled_flops(compiled) -> Optional[float]:
         flops = cost.get("flops")
         return float(flops) if flops else None
     except Exception:
+        if not _cost_analysis_noted:
+            _cost_analysis_noted = True
+            telemetry.counter(
+                "observability.cost_analysis_unavailable").inc()
         return None
 
 
@@ -81,7 +93,15 @@ def _eqn_flops(eqn) -> float:
 
 def _jaxpr_flops(jaxpr) -> float:
     """Recursive matmul/conv FLOPs of a (closed) jaxpr, expanding control
-    flow: scan multiplies by trip count, branches take the max."""
+    flow: scan multiplies by trip count, branches take the max.
+
+    Under-count contract: a ``while`` body has no static trip count, so it
+    is counted EXACTLY ONCE (the >=1 iterations guaranteed by nothing — a
+    zero-trip while over-counts, a multi-trip while under-counts). The
+    returned number is therefore a FLOOR whenever a ``while`` primitive is
+    present; MFU computed from it is a lower bound. Each ``while``
+    encountered bumps the ``observability.flops.while_floor`` counter so
+    downstream MFU consumers can tell a floor from an exact count."""
     if hasattr(jaxpr, "jaxpr"):  # ClosedJaxpr
         jaxpr = jaxpr.jaxpr
     total = 0.0
@@ -90,7 +110,9 @@ def _jaxpr_flops(jaxpr) -> float:
         if name == "scan":
             total += eqn.params["length"] * _jaxpr_flops(eqn.params["jaxpr"])
         elif name == "while":
-            total += _jaxpr_flops(eqn.params["body_jaxpr"])  # >=1 iteration
+            # body counted once — see the floor contract in the docstring
+            telemetry.counter("observability.flops.while_floor").inc()
+            total += _jaxpr_flops(eqn.params["body_jaxpr"])
         elif name == "cond":
             total += max(_jaxpr_flops(b) for b in eqn.params["branches"])
         elif name == "pallas_call":
@@ -119,8 +141,6 @@ def count_flops(fn, *args, **kwargs) -> float:
     (observed on TPU v5e), and elementwise FLOPs are noise next to the MXU
     work by definition of "model FLOPs utilization".
     """
-    import jax
-
     jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
     return _jaxpr_flops(jaxpr)
 
@@ -223,7 +243,9 @@ class StepTimer:
         yield self
         self.total_s = time.perf_counter() - t0
         self.steps = steps
-        self.mean_step_s = self.total_s / max(steps, 1)
+        # steps=0 measured nothing: a per-step mean would be fiction, and
+        # any throughput derived from it would divide by it — stay None
+        self.mean_step_s = self.total_s / steps if steps > 0 else None
 
 
 @contextlib.contextmanager

@@ -23,6 +23,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from distkeras_tpu.utils.jax_compat import shard_map
+
 
 def _block_update(logits, m, l, o, v):
     """Fold one [B,H,Tq,Tk] logit block into the (m, l, o) accumulators."""
@@ -115,6 +117,6 @@ def ring_attention_sharded(q, k, v, mesh, axis_name: str = "seq",
     else:
         wrapped = lambda q, k, v: fn(q, k, v)
         args = (q, k, v)
-    out = jax.shard_map(wrapped, mesh=mesh, in_specs=in_specs,
+    out = shard_map(wrapped, mesh=mesh, in_specs=in_specs,
                         out_specs=spec, check_vma=False)(*args)
     return out

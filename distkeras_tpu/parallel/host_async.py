@@ -25,13 +25,15 @@ of pickled TCP.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from distkeras_tpu import engine
+from distkeras_tpu import engine, telemetry
+from distkeras_tpu.data.prefetch import prefetch
 from distkeras_tpu.utils.fetch import device_get_batched
 from distkeras_tpu.parameter_servers import (
     DeltaParameterServer,
@@ -165,9 +167,16 @@ class HostAsyncRunner:
                         continue
                     center, clock = ps.pull()  # consistent under the PS lock
                     if clock > last_saved:
+                        t0 = time.perf_counter()
                         checkpointer.save(
                             clock, {"center": device_get_batched(center),
                                     "clock": np.array([clock], np.int64)})
+                        # the stall an in-commit-path save WOULD have cost
+                        # a worker (pull + fetch + save dispatch) — the
+                        # number that justifies the dedicated saver thread
+                        telemetry.histogram("host_async.save_s").record(
+                            time.perf_counter() - t0)
+                        telemetry.counter("host_async.save.count").inc()
                         last_saved = clock
             except Exception as e:  # surface save failures to the caller
                 errors.append(e)
@@ -177,30 +186,53 @@ class HostAsyncRunner:
         def worker(k: int):
             try:
                 dev = self.worker_devices[k]
+                wid = worker_offset + k  # GLOBAL worker id (telemetry label)
+                pull_h = telemetry.histogram("host_async.pull_s", worker=wid)
+                win_h = telemetry.histogram("host_async.window_s", worker=wid)
+                commit_h = telemetry.histogram("host_async.commit_s",
+                                               worker=wid)
+                lag_h = telemetry.histogram("host_async.commit_clock_lag",
+                                            worker=wid)
                 carry = jax.device_put(
                     self.strategy.init_carry(init_params, self.tx), dev)
                 fold = 0
-                for shards in epoch_shards:
-                    for rnd, batches in enumerate(shards[k]):
-                        if abort.is_set():
-                            return  # a sibling died: stop wasting windows
-                        center, clock = ps.pull()
-                        carry, commit, ms = self.window_fn(
-                            carry, jax.device_put(center, dev),
-                            jax.device_put(batches, dev),
-                            np.int32((worker_offset + k) * 1_000_003 + fold))
-                        jax.block_until_ready(commit)
-                        clock_at_fold = ps.commit(commit, last_update=clock)
-                        ms = device_get_batched(ms)
-                        n = len(ms["loss"])
-                        windows[k].append((
-                            clock_at_fold, clock_at_fold - clock,
-                            [{key: float(v[i]) for key, v in ms.items()}
-                             for i in range(n)]))
-                        if checkpointing and \
-                                (clock_at_fold + 1) % checkpoint_folds == 0:
-                            save_trigger.set()  # non-blocking hand-off
-                        fold += 1
+
+                def staged_rounds():
+                    # device placement runs on the prefetch thread one
+                    # round ahead, so H2D staging overlaps the previous
+                    # window's compute
+                    for shards in epoch_shards:
+                        for batches in shards[k]:
+                            yield jax.device_put(batches, dev)
+
+                for batches in prefetch(staged_rounds(), depth=1):
+                    if abort.is_set():
+                        return  # a sibling died: stop wasting windows
+                    t0 = time.perf_counter()
+                    center, clock = ps.pull()
+                    t1 = time.perf_counter()
+                    pull_h.record(t1 - t0)
+                    carry, commit, ms = self.window_fn(
+                        carry, jax.device_put(center, dev), batches,
+                        np.int32(wid * 1_000_003 + fold))
+                    jax.block_until_ready(commit)
+                    t2 = time.perf_counter()
+                    win_h.record(t2 - t1)
+                    clock_at_fold = ps.commit(commit, last_update=clock)
+                    commit_h.record(time.perf_counter() - t2)
+                    # commits the center absorbed between this worker's
+                    # pull and its own fold — real scheduling staleness
+                    lag_h.record(clock_at_fold - clock)
+                    ms = device_get_batched(ms)
+                    n = len(ms["loss"])
+                    windows[k].append((
+                        clock_at_fold, clock_at_fold - clock,
+                        [{key: float(v[i]) for key, v in ms.items()}
+                         for i in range(n)]))
+                    if checkpointing and \
+                            (clock_at_fold + 1) % checkpoint_folds == 0:
+                        save_trigger.set()  # non-blocking hand-off
+                    fold += 1
             except Exception as e:  # surface thread failures to the caller
                 errors.append(e)
                 abort.set()  # fail fast: siblings stop at their next round

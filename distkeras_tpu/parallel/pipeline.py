@@ -37,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distkeras_tpu.models.gpt import DecoderBlock
 from distkeras_tpu.parallel import mesh as mesh_lib
+from distkeras_tpu.utils.jax_compat import shard_map
 
 STAGE_AXIS = "stages"
 
@@ -206,7 +207,7 @@ class PipelinedLM:
         def loss_shmapped(params, ids_mb, labels_mb):
             specs = {"embed": P(), "head": P(),
                      "blocks": blocks_spec(params["blocks"])}
-            fn = jax.shard_map(
+            fn = shard_map(
                 pp_loss, mesh=mesh,
                 in_specs=(specs, P(), P()),
                 out_specs=(P(), (P(), P(), P())),
@@ -372,7 +373,7 @@ class GenericPipeline:
             return loss_sum / loss_cnt
 
         def loss_shmapped(params, feats_mb, labels_mb):
-            fn = jax.shard_map(
+            fn = shard_map(
                 pp_loss, mesh=mesh,
                 in_specs=(tuple(jax.tree.map(lambda _: P(), p)
                                 for p in params), P(), P()),

@@ -37,11 +37,13 @@ import json
 import socket
 import struct
 import threading
+import time
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
+from distkeras_tpu import telemetry
 from distkeras_tpu.parameter_servers import ParameterServer
 from distkeras_tpu.utils.fetch import device_get_batched
 
@@ -166,6 +168,8 @@ class ParameterServerService:
 
     # -- per-connection handler (reference: handle_connection) ------------
     def _serve(self, conn: socket.socket):
+        inflight = telemetry.gauge("remote_ps.server.inflight_connections")
+        inflight.add(1)
         try:
             with conn:
                 while True:
@@ -177,9 +181,22 @@ class ParameterServerService:
         except Exception:
             if self._running:  # surface handler crashes, don't die silently
                 raise
+        finally:
+            inflight.add(-1)
 
     def _dispatch(self, conn, header: dict, blobs: list):
         op = header["op"]
+        telemetry.counter("remote_ps.server.dispatch", op=op).inc()
+        telemetry.counter("remote_ps.server.bytes_received").inc(
+            sum(len(b) for b in blobs))
+        t0 = time.perf_counter()
+        try:
+            self._dispatch_op(conn, op, header, blobs)
+        finally:
+            telemetry.histogram("remote_ps.server.handle_s",
+                                op=op).record(time.perf_counter() - t0)
+
+    def _dispatch_op(self, conn, op: str, header: dict, blobs: list):
         if op == "pull":
             center, clock = self.ps.pull()
             _sendall(conn, {"clock": clock}, self.codec.encode(center))
@@ -257,9 +274,20 @@ class RemoteParameterServer:
         self._lock = threading.Lock()
 
     def _roundtrip(self, header: dict, blobs=()) -> Tuple[dict, list]:
+        op = header.get("op", "?")
+        t0 = time.perf_counter()
         with self._lock:
             _sendall(self._sock, header, blobs)
             resp, rblobs = _recv(self._sock)
+        # rtt includes the wait for the shared connection: the contention
+        # profile of the one-socket-per-process design is part of what a
+        # STALENESS round wants to see
+        telemetry.histogram("remote_ps.client.rtt_s",
+                            op=op).record(time.perf_counter() - t0)
+        telemetry.counter("remote_ps.client.bytes_sent").inc(
+            sum(len(b) for b in blobs))
+        telemetry.counter("remote_ps.client.bytes_received").inc(
+            sum(len(b) for b in rblobs))
         if "error" in resp:
             raise RuntimeError(f"parameter service: {resp['error']}")
         return resp, rblobs

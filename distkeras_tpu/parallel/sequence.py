@@ -19,6 +19,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distkeras_tpu import engine
 from distkeras_tpu.parallel import mesh as mesh_lib
+from distkeras_tpu.utils.jax_compat import shard_map
 
 SEQ_AXIS = "seq"
 
@@ -86,7 +87,7 @@ def build_sp_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         return params, opt_state, step_i + 1, ms
 
     data_spec = P(mesh_lib.WORKER_AXIS, SEQ_AXIS)
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_step, mesh=mesh,
         in_specs=(P(), P(), P(), data_spec, data_spec),
         out_specs=(P(), P(), P(), P()),

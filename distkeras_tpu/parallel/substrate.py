@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distkeras_tpu import engine
 from distkeras_tpu.parallel import mesh as mesh_lib
 from distkeras_tpu.parallel.strategies import Carry, Strategy
+from distkeras_tpu.utils.jax_compat import shard_map
 from distkeras_tpu.utils.trees import tree_add, tree_scale
 
 WORKERS = mesh_lib.WORKER_AXIS
@@ -137,7 +138,7 @@ def build_epoch_fn(model, loss, tx: optax.GradientTransformation,
         ms = jax.tree.map(lambda x: jnp.moveaxis(x, 0, 1), ms)
         return center, carry, ms
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         worker_epoch, mesh=mesh,
         in_specs=(P(), P(WORKERS), P(None, WORKERS), P()),
         out_specs=(P(), P(WORKERS), P(WORKERS)),

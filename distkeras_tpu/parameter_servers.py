@@ -23,11 +23,13 @@ with an in-graph ``psum`` (see parallel/substrate.py). This module exists for
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 
+from distkeras_tpu import telemetry
 from distkeras_tpu.utils.trees import tree_add, tree_scale
 
 
@@ -48,7 +50,18 @@ class ParameterServer:
     # to compute staleness at its next commit).
     def pull(self):
         with self._lock:
-            return self.center_variable, self.num_updates
+            out = self.center_variable, self.num_updates
+        telemetry.counter("ps.pull.count").inc()
+        return out
+
+    def _note_commit(self, staleness: int, dur_s: float) -> None:
+        """Commit bookkeeping, OUTSIDE the PS lock: a committer records its
+        own fold's staleness (server clock at fold minus clock at its pull)
+        and the host-side handle time (lock wait + jitted fold DISPATCH —
+        the fold itself runs async on device; no sync is added here)."""
+        telemetry.counter("ps.commit.count").inc()
+        telemetry.histogram("ps.commit.staleness").record(staleness)
+        telemetry.histogram("ps.commit.handle_s").record(dur_s)
 
     def commit(self, delta: Any, last_update: int = 0) -> int:
         """Fold a delta into the center. Returns the server clock at fold
@@ -85,12 +98,15 @@ class DeltaParameterServer(ParameterServer):
 
     def commit(self, delta: Any, last_update: int = 0) -> int:
         delta = self._to_center_device(delta)
+        t0 = time.perf_counter()
         with self._lock:
             at_fold = self.num_updates
             self.center_variable = _fold(self.center_variable, delta,
                                          jnp.float32(1.0))
             self.num_updates += 1
-            return at_fold
+        self._note_commit(at_fold - int(last_update),
+                          time.perf_counter() - t0)
+        return at_fold
 
 
 # The reference gives ADAG its own server class; the fold is identical to
@@ -104,6 +120,7 @@ class DynSGDParameterServer(ParameterServer):
 
     def commit(self, delta: Any, last_update: int = 0) -> int:
         delta = self._to_center_device(delta)
+        t0 = time.perf_counter()
         with self._lock:
             at_fold = self.num_updates
             staleness = at_fold - int(last_update)
@@ -114,4 +131,5 @@ class DynSGDParameterServer(ParameterServer):
             self.center_variable = _fold(self.center_variable, delta,
                                          jnp.float32(1.0 / (staleness + 1)))
             self.num_updates += 1
-            return at_fold
+        self._note_commit(staleness, time.perf_counter() - t0)
+        return at_fold

@@ -1,0 +1,409 @@
+"""Runtime telemetry: structured metrics + span tracing for the system side.
+
+``observability.py`` covers the *compute* side (FLOPs, MFU, profiler
+traces). This module covers the *system* side the reference never had and
+the async zoo badly needs: PS RPC latency, commit staleness distributions,
+worker window timing, prefetch queue occupancy. A process-local
+:class:`MetricsRegistry` holds counters, gauges and bounded histograms; a
+``with span("ps.commit"): ...`` tracer records wall-clock durations (and a
+bounded event timeline with monotonic timestamps); ``dump_jsonl`` leaves a
+machine-readable artifact next to the BENCH_*.json files.
+
+Design constraints (enforced by tests/test_telemetry.py):
+
+- **No jax import.** Nothing here can touch a device, so instrumentation
+  can never introduce a device sync on the step path.
+- **Lock-free record path.** Counters and histograms shard their state
+  per thread (``threading.local``); ``inc``/``record``/``set``/``add``
+  touch only the calling thread's shard — no lock, no contention from
+  ``host_async`` worker threads. The only locks are on metric *creation*
+  (first call for a given name+labels) and shard registration (first call
+  per thread per metric); after that the hot path is a dict hit plus a few
+  attribute ops (~1 µs).
+- **Cleanly disabled.** A default registry is installed at import (the
+  telemetry is default-on); ``uninstall()`` turns every module-level
+  accessor into a shared no-op metric, so instrumented call sites cost one
+  ``None`` check and a no-op method call.
+
+JSONL schema (one object per line; see DESIGN.md §5b):
+
+    {"kind": "counter",   "name": ..., "labels": {...}, "value": N}
+    {"kind": "gauge",     "name": ..., "labels": {...}, "value": X}
+    {"kind": "histogram", "name": ..., "labels": {...}, "count": N,
+     "sum": S, "min": m, "max": M, "p50": ..., "p95": ...,
+     "samples_kept": K}
+    {"kind": "span", "name": ..., "labels": {...}, "t0": monotonic_start,
+     "dur_s": ...}
+
+Histograms are *bounded*: each thread shard keeps a ring of the most
+recent ``max_samples`` values (count/sum/min/max stay exact over ALL
+samples; percentiles are computed from the kept ring, i.e. they are
+recency-weighted once a shard overflows).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+__all__ = [
+    "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "get_registry", "install", "uninstall", "reset",
+    "counter", "gauge", "histogram", "span", "load_jsonl",
+]
+
+SCHEMA_VERSION = 1
+
+#: Per-thread-shard ring size for histograms. 1024 doubles (per writing
+#: thread) bounds memory while keeping p50/p95 meaningful for the window
+#: counts real runs produce (a 10-epoch async run commits O(1e3) windows).
+DEFAULT_MAX_SAMPLES = 1024
+
+#: Bounded span-event timeline (registry-wide). deque(maxlen=) appends are
+#: atomic in CPython, so the span record path needs no lock either.
+MAX_SPAN_EVENTS = 4096
+
+
+def _full_name(name: str, labels: Dict[str, Any]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class _Metric:
+    """Shared shard plumbing: per-thread state boxes, created lock-free on
+    the hot path after the first call per thread."""
+
+    kind = "metric"
+
+    def __init__(self, name: str, labels: Dict[str, Any]):
+        self.name = name
+        self.labels = dict(labels)
+        self._local = threading.local()
+        self._shards: List[Any] = []
+        self._shards_lock = threading.Lock()  # shard CREATION only
+
+    def _shard(self):
+        shard = getattr(self._local, "shard", None)
+        if shard is None:
+            shard = self._new_shard()
+            self._local.shard = shard
+            with self._shards_lock:
+                self._shards.append(shard)
+        return shard
+
+    def _new_shard(self):
+        raise NotImplementedError
+
+    @property
+    def full_name(self) -> str:
+        return _full_name(self.name, self.labels)
+
+    def row(self) -> dict:
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    """Monotonic count. ``inc`` adds to the calling thread's shard; the
+    value is the sum over shards (reading concurrent ints is safe under
+    the GIL — at worst a read misses an in-flight bump)."""
+
+    kind = "counter"
+
+    def _new_shard(self):
+        return [0]
+
+    def inc(self, n: int = 1) -> None:
+        if n < 0:
+            raise ValueError(f"Counter is monotonic; use a Gauge for "
+                             f"up/down values (got inc({n}))")
+        self._shard()[0] += n
+
+    @property
+    def value(self):
+        return sum(s[0] for s in list(self._shards))
+
+    def row(self) -> dict:
+        return {"kind": self.kind, "name": self.name, "labels": self.labels,
+                "value": self.value}
+
+
+class Gauge(_Metric):
+    """Last-write-wins ``set`` plus lock-free up/down ``add`` deltas:
+    ``value = last set + sum of adds`` (in-flight counts use add(±1))."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, labels: Dict[str, Any]):
+        super().__init__(name, labels)
+        self._base = 0.0
+
+    def _new_shard(self):
+        return [0.0]
+
+    def set(self, value: float) -> None:
+        self._base = value
+
+    def add(self, n: float) -> None:
+        self._shard()[0] += n
+
+    @property
+    def value(self) -> float:
+        return self._base + sum(s[0] for s in list(self._shards))
+
+    def row(self) -> dict:
+        return {"kind": self.kind, "name": self.name, "labels": self.labels,
+                "value": self.value}
+
+
+class _HistShard:
+    __slots__ = ("n", "total", "lo", "hi", "ring", "i", "cap")
+
+    def __init__(self, cap: int):
+        self.n = 0
+        self.total = 0.0
+        self.lo = float("inf")
+        self.hi = float("-inf")
+        self.ring: List[float] = []
+        self.i = 0
+        self.cap = cap
+
+
+class Histogram(_Metric):
+    """Bounded histogram: exact count/sum/min/max over every sample, p50/p95
+    from a per-thread ring of the most recent ``max_samples`` values."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, labels: Dict[str, Any],
+                 max_samples: int = DEFAULT_MAX_SAMPLES):
+        super().__init__(name, labels)
+        if max_samples < 1:
+            raise ValueError(f"max_samples must be >= 1, got {max_samples}")
+        self.max_samples = int(max_samples)
+
+    def _new_shard(self):
+        return _HistShard(self.max_samples)
+
+    def record(self, value: float) -> None:
+        v = float(value)
+        s = self._shard()
+        s.n += 1
+        s.total += v
+        if v < s.lo:
+            s.lo = v
+        if v > s.hi:
+            s.hi = v
+        if len(s.ring) < s.cap:
+            s.ring.append(v)
+        else:  # overwrite oldest: bounded memory, recency-weighted kept set
+            s.ring[s.i] = v
+            s.i = (s.i + 1) % s.cap
+
+    def stats(self) -> dict:
+        shards = list(self._shards)
+        n = sum(s.n for s in shards)
+        if n == 0:
+            return {"count": 0, "sum": 0.0, "min": None, "max": None,
+                    "p50": None, "p95": None, "samples_kept": 0}
+        kept = sorted(v for s in shards for v in s.ring)
+
+        def pct(q: float) -> float:
+            return kept[min(len(kept) - 1, int(q * len(kept)))]
+
+        return {"count": n,
+                "sum": sum(s.total for s in shards),
+                "min": min(s.lo for s in shards),
+                "max": max(s.hi for s in shards),
+                "p50": pct(0.50), "p95": pct(0.95),
+                "samples_kept": len(kept)}
+
+    def row(self) -> dict:
+        out = {"kind": self.kind, "name": self.name, "labels": self.labels}
+        out.update(self.stats())
+        return out
+
+
+class _NullMetric:
+    """Shared no-op standing in for every metric when no registry is
+    installed — call sites stay branch-free."""
+
+    def inc(self, n: int = 1) -> None:
+        pass
+
+    def set(self, value: float) -> None:
+        pass
+
+    def add(self, n: float) -> None:
+        pass
+
+    def record(self, value: float) -> None:
+        pass
+
+    @property
+    def value(self):
+        return 0
+
+
+_NULL = _NullMetric()
+
+
+class MetricsRegistry:
+    """Process-local metric store. Creation (``counter``/``gauge``/
+    ``histogram``) is get-or-create keyed by (name, labels): the fast path
+    is an unlocked dict read (safe in CPython), the miss path takes the
+    creation lock once per metric."""
+
+    def __init__(self):
+        self._metrics: Dict[Tuple[str, tuple], _Metric] = {}
+        self._create_lock = threading.Lock()
+        self.spans: "collections.deque" = collections.deque(
+            maxlen=MAX_SPAN_EVENTS)
+
+    def _get(self, cls, name: str, labels: Dict[str, Any], **kw) -> _Metric:
+        key = (name, tuple(sorted(labels.items())))
+        m = self._metrics.get(key)
+        if m is None:
+            with self._create_lock:
+                m = self._metrics.get(key)
+                if m is None:
+                    m = cls(name, labels, **kw)
+                    self._metrics[key] = m
+        if not isinstance(m, cls):
+            raise TypeError(f"metric {_full_name(name, labels)!r} already "
+                            f"registered as {m.kind}, requested {cls.kind}")
+        return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, max_samples: int = DEFAULT_MAX_SAMPLES,
+                  **labels) -> Histogram:
+        return self._get(Histogram, name, labels, max_samples=max_samples)
+
+    def record_span(self, name: str, t0: float, dur_s: float,
+                    labels: Dict[str, Any]) -> None:
+        self.spans.append((name, t0, dur_s, labels))
+        self.histogram(f"span.{name}.duration_s", **labels).record(dur_s)
+
+    # -- export -----------------------------------------------------------
+    def rows(self) -> Iterator[dict]:
+        for m in list(self._metrics.values()):
+            yield m.row()
+        for name, t0, dur, labels in list(self.spans):
+            yield {"kind": "span", "name": name, "labels": labels,
+                   "t0": t0, "dur_s": dur}
+
+    def snapshot(self) -> dict:
+        """Structured view for ``Trainer.get_telemetry()``: metric rows
+        grouped by kind, keyed by ``name{label=...}``."""
+        out: dict = {"counters": {}, "gauges": {}, "histograms": {},
+                     "spans": []}
+        for row in self.rows():
+            kind = row["kind"]
+            if kind == "span":
+                out["spans"].append(row)
+                continue
+            key = _full_name(row["name"], row["labels"])
+            if kind == "counter":
+                out["counters"][key] = row["value"]
+            elif kind == "gauge":
+                out["gauges"][key] = row["value"]
+            else:
+                out["histograms"][key] = {
+                    k: v for k, v in row.items()
+                    if k not in ("kind", "name", "labels")}
+        return out
+
+    def dump_jsonl(self, path: str) -> str:
+        """Write every metric + span event as JSON lines; returns ``path``."""
+        with open(path, "w") as f:
+            f.write(json.dumps({"kind": "meta", "schema": SCHEMA_VERSION,
+                                "unix_time": time.time()}) + "\n")
+            for row in self.rows():
+                f.write(json.dumps(row) + "\n")
+        return path
+
+    def clear(self) -> None:
+        with self._create_lock:
+            self._metrics.clear()
+        self.spans.clear()
+
+
+def load_jsonl(path: str) -> List[dict]:
+    """Load a dumped artifact back into a list of row dicts (meta line
+    included as row 0)."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows
+
+
+# -- module-level default registry (telemetry is default-ON) ----------------
+
+_default = MetricsRegistry()
+_installed: Optional[MetricsRegistry] = _default
+
+
+def get_registry() -> Optional[MetricsRegistry]:
+    return _installed
+
+
+def install(registry: MetricsRegistry) -> MetricsRegistry:
+    """Swap the process registry (tests install a fresh one per case)."""
+    global _installed
+    _installed = registry
+    return registry
+
+
+def uninstall() -> None:
+    """Disable telemetry: module-level accessors become no-ops."""
+    global _installed
+    _installed = None
+
+
+def reset() -> MetricsRegistry:
+    """Install a fresh registry (and return it) — run isolation helper."""
+    return install(MetricsRegistry())
+
+
+def counter(name: str, **labels):
+    reg = _installed
+    return _NULL if reg is None else reg.counter(name, **labels)
+
+
+def gauge(name: str, **labels):
+    reg = _installed
+    return _NULL if reg is None else reg.gauge(name, **labels)
+
+
+def histogram(name: str, **labels):
+    reg = _installed
+    return _NULL if reg is None else reg.histogram(name, **labels)
+
+
+@contextlib.contextmanager
+def span(name: str, **labels):
+    """Time a block into ``span.<name>.duration_s`` (+ the event timeline).
+    Timestamps are ``time.monotonic``-class (perf_counter); pairs of events
+    order correctly within a process but mean nothing across processes."""
+    reg = _installed
+    if reg is None:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        reg.record_span(name, t0, time.perf_counter() - t0, labels)

@@ -28,8 +28,9 @@ import jax
 import numpy as np
 import optax
 
-from distkeras_tpu import engine
+from distkeras_tpu import engine, telemetry
 from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.telemetry import span
 from distkeras_tpu.ops import losses as losses_lib
 from distkeras_tpu.ops import optimizers as opt_lib
 from distkeras_tpu.utils.fetch import device_get_batched
@@ -46,7 +47,8 @@ class Trainer:
                  features_col: str = "features", label_col: str = "label",
                  batch_size: int = 32, num_epoch: int = 1, seed: int = 0,
                  loss_weights=None,
-                 checkpoint_dir: Optional[str] = None):
+                 checkpoint_dir: Optional[str] = None,
+                 telemetry_path: Optional[str] = None):
         self.model = model
         self.loss = loss
         base_loss = losses_lib.get(loss)  # fail fast on unknown loss names
@@ -77,6 +79,9 @@ class Trainer:
         self.num_epoch = int(num_epoch)
         self.seed = int(seed)
         self.checkpoint_dir = checkpoint_dir
+        # where to dump the telemetry JSONL artifact when train() finishes
+        # (None: keep it in-process only — read it with get_telemetry())
+        self.telemetry_path = telemetry_path
 
         self.tx = opt_lib.get(worker_optimizer, learning_rate)
         self.params = None
@@ -128,6 +133,24 @@ class Trainer:
 
     def _stop(self):
         self.training_time = time.perf_counter() - self._t0
+        telemetry.gauge("trainer.training_time_s").set(self.training_time)
+        if self.telemetry_path is not None:
+            self.dump_telemetry(self.telemetry_path)
+
+    # -- telemetry (system-side observability; see DESIGN.md §5b) ----------
+    def get_telemetry(self) -> dict:
+        """Snapshot of the process registry (counters/gauges/histograms/
+        spans). The registry is process-local, so back-to-back trainers in
+        one process accumulate — call ``telemetry.reset()`` between runs
+        for per-run numbers. Empty when telemetry is uninstalled."""
+        reg = telemetry.get_registry()
+        return reg.snapshot() if reg is not None else {}
+
+    def dump_telemetry(self, path: str) -> Optional[str]:
+        """Write the JSONL artifact (``benchmarks/telemetry_summary.py``
+        renders it); returns the path, or None when uninstalled."""
+        reg = telemetry.get_registry()
+        return reg.dump_jsonl(path) if reg is not None else None
 
     def get_training_time(self) -> float:
         return self.training_time
@@ -260,11 +283,13 @@ class DistributedTrainer(Trainer):
                  staging_rounds: Optional[int] = None,
                  data_layout: str = "replicated",
                  devices=None,
+                 telemetry_path: Optional[str] = None,
                  **strategy_kwargs):
         super().__init__(model, loss, worker_optimizer, learning_rate,
                          metrics, features_col, label_col, batch_size,
                          num_epoch, seed, loss_weights=loss_weights,
-                         checkpoint_dir=checkpoint_dir)
+                         checkpoint_dir=checkpoint_dir,
+                         telemetry_path=telemetry_path)
         from distkeras_tpu.parallel import mesh as mesh_lib
 
         if mode not in ("sync", "host_async"):
@@ -541,7 +566,8 @@ class DistributedTrainer(Trainer):
         # stage_epoch_chunks (every process sees global min 0 and raises)
         if self.staging_rounds is None:
             self._warn_if_large_resident(dataset, "staging_rounds")
-        center, carries = self._setup_state(dataset)
+        with span("trainer.init"):
+            center, carries = self._setup_state(dataset)
         ckpt = self._checkpointer()
         if ckpt is not None:
             try:
@@ -558,10 +584,13 @@ class DistributedTrainer(Trainer):
         # (warm restarts, benchmark loops) reuse the jit cache instead of
         # paying a full recompile each time
         if getattr(self, "_epoch_fn", None) is None:
-            self._epoch_fn = substrate.build_epoch_fn(
-                self.model, self.loss, self.tx, self.strategy, self.mesh,
-                self.num_workers, self.communication_window, self.metrics,
-                dropout_seed=self.seed)
+            # span covers tracing/jit construction; XLA compilation itself
+            # is lazy — it lands inside the first trainer.epoch span
+            with span("trainer.compile"):
+                self._epoch_fn = substrate.build_epoch_fn(
+                    self.model, self.loss, self.tx, self.strategy, self.mesh,
+                    self.num_workers, self.communication_window, self.metrics,
+                    dropout_seed=self.seed)
         epoch_fn = self._epoch_fn
         self.history = []
         self.staleness_history = []
@@ -578,25 +607,30 @@ class DistributedTrainer(Trainer):
             # don't serialize the chunks.
             ds_epoch = provider.epoch_dataset(epoch) if provider is not None \
                 else dataset
-            chunks, staged = self._epoch_chunk_stream(
-                staged,
-                lambda: substrate.stage_epoch_chunks(
-                    (ds_epoch.shuffle(self.seed + epoch)
-                     if shuffle else ds_epoch).repartition(n_shards),
-                    self.features_col, self.label_col, self.batch_size,
-                    self.communication_window, self.mesh,
-                    chunk_rounds=self.staging_rounds,
-                    local_positions=positions),
-                resident=(not shuffle and self.staging_rounds is None
-                          and provider is None))
-            pending = []
-            for data, rounds in chunks:
-                center, carries, ms = epoch_fn(center, carries, data,
-                                               np.int32(round_offset))
-                round_offset += rounds
-                pending.append((ms, rounds))
-            for ms, rounds in pending:
-                self._record(device_get_batched(ms), rounds)
+            with span("trainer.stage"):
+                # resident mode materializes every chunk here; streaming
+                # mode only builds the prefetch generator (the real staging
+                # cost then overlaps compute inside trainer.epoch)
+                chunks, staged = self._epoch_chunk_stream(
+                    staged,
+                    lambda: substrate.stage_epoch_chunks(
+                        (ds_epoch.shuffle(self.seed + epoch)
+                         if shuffle else ds_epoch).repartition(n_shards),
+                        self.features_col, self.label_col, self.batch_size,
+                        self.communication_window, self.mesh,
+                        chunk_rounds=self.staging_rounds,
+                        local_positions=positions),
+                    resident=(not shuffle and self.staging_rounds is None
+                              and provider is None))
+            with span("trainer.epoch"):
+                pending = []
+                for data, rounds in chunks:
+                    center, carries, ms = epoch_fn(center, carries, data,
+                                                   np.int32(round_offset))
+                    round_offset += rounds
+                    pending.append((ms, rounds))
+                for ms, rounds in pending:
+                    self._record(device_get_batched(ms), rounds)
             if ckpt is not None:
                 # counters[2] records the topology so a later resume can
                 # detect a worker-count change before any shape restore
@@ -607,7 +641,8 @@ class DistributedTrainer(Trainer):
         if ckpt is not None:
             ckpt.wait()
             ckpt.close()
-        self.params = self._finalize(center, carries)
+        with span("trainer.finalize"):
+            self.params = self._finalize(center, carries)
         self._stop()
         return self.params
 
@@ -699,7 +734,8 @@ class DistributedTrainer(Trainer):
                     self.features_col, self.label_col, self.batch_size,
                     self.communication_window)
 
-        state = self._init_params(dataset)
+        with span("trainer.init"):
+            state = self._init_params(dataset)
         init_params, start_clock = state.params, 0
         # process 0 alone owns the live center's snapshots; Orbax must not
         # expect its peers at any barrier (local_host_only)
@@ -760,28 +796,33 @@ class DistributedTrainer(Trainer):
                     f"{self.num_epoch} epochs). For large datasets use "
                     f"mode='sync' with staging_rounds= (O(chunk) memory).",
                     RuntimeWarning, stacklevel=3)
-            epoch_shards = [stage(ds_for(e)) for e in range(self.num_epoch)]
+            with span("trainer.stage"):
+                epoch_shards = [stage(ds_for(e))
+                                for e in range(self.num_epoch)]
         else:
-            epoch_shards = [stage(dataset)] * self.num_epoch
+            with span("trainer.stage"):
+                epoch_shards = [stage(dataset)] * self.num_epoch
         if getattr(self, "_async_runner", None) is None:
-            self._async_runner = host_async.HostAsyncRunner(
-                self.model, self.loss, self.tx, self.strategy,
-                self.communication_window, self.metrics, self.seed,
-                devices=self.devices or jax.local_devices())
+            with span("trainer.compile"):
+                self._async_runner = host_async.HostAsyncRunner(
+                    self.model, self.loss, self.tx, self.strategy,
+                    self.communication_window, self.metrics, self.seed,
+                    devices=self.devices or jax.local_devices())
         runner = self._async_runner
         folds = (self.checkpoint_folds or self.num_workers) \
             if ckpt is not None else 0
         try:
-            if multi:
-                params, history, staleness, num_updates = \
-                    host_async.run_cross_process(
-                        runner, init_params, epoch_shards,
-                        worker_offset=worker_offset, checkpointer=ckpt,
+            with span("trainer.epoch"):  # one span: workers cross epoch
+                if multi:                # boundaries without barriers
+                    params, history, staleness, num_updates = \
+                        host_async.run_cross_process(
+                            runner, init_params, epoch_shards,
+                            worker_offset=worker_offset, checkpointer=ckpt,
+                            checkpoint_folds=folds, start_clock=start_clock)
+                else:
+                    params, history, staleness, num_updates = runner.run(
+                        init_params, epoch_shards, checkpointer=ckpt,
                         checkpoint_folds=folds, start_clock=start_clock)
-            else:
-                params, history, staleness, num_updates = runner.run(
-                    init_params, epoch_shards, checkpointer=ckpt,
-                    checkpoint_folds=folds, start_clock=start_clock)
         except BaseException:
             if ckpt is not None:  # crash path: finalize in-flight snapshots
                 try:              # so resume sees the last completed one
@@ -789,15 +830,16 @@ class DistributedTrainer(Trainer):
                 finally:          # close even if the flush itself fails, and
                     ckpt.close()  # let the TRAINING error propagate
             raise
-        if ckpt is not None:
-            # final snapshot so a completed run is always resumable from its
-            # end state, then flush the async saves
-            if num_updates > (ckpt.latest_step() or 0):
-                ckpt.save(num_updates,  # runner already fetched params to host
-                          {"center": params,
-                           "clock": np.array([num_updates], np.int64)})
-            ckpt.wait()
-            ckpt.close()
+        with span("trainer.finalize"):
+            # runner.run already merged history + fetched the center; what
+            # remains is the final resumability snapshot and save flush
+            if ckpt is not None:
+                if num_updates > (ckpt.latest_step() or 0):
+                    ckpt.save(num_updates,  # params already fetched to host
+                              {"center": params,
+                               "clock": np.array([num_updates], np.int64)})
+                ckpt.wait()
+                ckpt.close()
         self.history = history
         self.staleness_history = staleness
         self.num_updates = num_updates
@@ -920,11 +962,13 @@ class PjitTrainer(Trainer):
                  mesh=None, seed: int = 0, loss_weights=None,
                  checkpoint_dir: Optional[str] = None,
                  staging_steps: Optional[int] = None,
-                 data_layout: str = "replicated"):
+                 data_layout: str = "replicated",
+                 telemetry_path: Optional[str] = None):
         super().__init__(model, loss, worker_optimizer, learning_rate,
                          metrics, features_col, label_col, batch_size,
                          num_epoch, seed, loss_weights=loss_weights,
-                         checkpoint_dir=checkpoint_dir)
+                         checkpoint_dir=checkpoint_dir,
+                         telemetry_path=telemetry_path)
         from distkeras_tpu.parallel import mesh as mesh_lib
 
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(
@@ -991,11 +1035,13 @@ class PjitTrainer(Trainer):
             self._check_trainable(dataset, local_batch)
         if self.staging_steps is None:
             self._warn_if_large_resident(dataset, "staging_steps")
-        state = self._init_params(dataset)
+        with span("trainer.init"):
+            state = self._init_params(dataset)
         if getattr(self, "_pjit_fns", None) is None:
-            self._pjit_fns = tensor.build_pjit_epoch_fn(
-                self.model, self.loss, self.tx, self.mesh, self.metrics,
-                self.partition_rules, dropout_seed=self.seed)
+            with span("trainer.compile"):
+                self._pjit_fns = tensor.build_pjit_epoch_fn(
+                    self.model, self.loss, self.tx, self.mesh, self.metrics,
+                    self.partition_rules, dropout_seed=self.seed)
         epoch_fn, place_state, place_data = self._pjit_fns
         if positions is not None:
             data_sharding = NamedSharding(
@@ -1018,26 +1064,28 @@ class PjitTrainer(Trainer):
             # Same single code path as DistributedTrainer.train: the
             # staging_steps=None default is the one-chunk case, cached
             # across epochs when not shuffling.
-            chunks, staged = self._epoch_chunk_stream(
-                staged,
-                lambda: ((place_data(data), steps)
-                         for data, steps in tensor.stage_step_chunks(
-                             dataset.shuffle(self.seed + epoch)
-                             if shuffle else dataset,
-                             self.features_col, self.label_col,
-                             local_batch, chunk_steps=self.staging_steps,
-                             max_steps=max_steps)),
-                resident=not shuffle and self.staging_steps is None)
-            pending = []
-            for data, steps in chunks:
-                state, ms = epoch_fn(state, data, np.int32(step_offset))
-                step_offset += steps
-                pending.append((ms, steps))
-            for ms, steps in pending:
-                host = device_get_batched(ms)
-                self.history.extend(
-                    {k: float(v[i]) for k, v in host.items()}
-                    for i in range(steps))
+            with span("trainer.stage"):
+                chunks, staged = self._epoch_chunk_stream(
+                    staged,
+                    lambda: ((place_data(data), steps)
+                             for data, steps in tensor.stage_step_chunks(
+                                 dataset.shuffle(self.seed + epoch)
+                                 if shuffle else dataset,
+                                 self.features_col, self.label_col,
+                                 local_batch, chunk_steps=self.staging_steps,
+                                 max_steps=max_steps)),
+                    resident=not shuffle and self.staging_steps is None)
+            with span("trainer.epoch"):
+                pending = []
+                for data, steps in chunks:
+                    state, ms = epoch_fn(state, data, np.int32(step_offset))
+                    step_offset += steps
+                    pending.append((ms, steps))
+                for ms, steps in pending:
+                    host = device_get_batched(ms)
+                    self.history.extend(
+                        {k: float(v[i]) for k, v in host.items()}
+                        for i in range(steps))
             if ckpt is not None:
                 ckpt.save(epoch, {"state": state,
                                   "counters": np.array([step_offset],
@@ -1045,7 +1093,8 @@ class PjitTrainer(Trainer):
         if ckpt is not None:
             ckpt.wait()
             ckpt.close()
-        self.params = device_get_batched(state.params)
+        with span("trainer.finalize"):
+            self.params = device_get_batched(state.params)
         self._stop()
         return self.params
 
@@ -1075,7 +1124,8 @@ class SingleTrainer(Trainer):
         self._check_trainable(dataset, self.batch_size)
         if self.staging_steps is None:
             self._warn_if_large_resident(dataset, "staging_steps")
-        state = self._init_params(dataset)
+        with span("trainer.init"):
+            state = self._init_params(dataset)
         ckpt = self._checkpointer()
         snap, start_epoch = self._maybe_resume(ckpt, {"state": state}, resume)
         state = snap["state"]
@@ -1083,34 +1133,39 @@ class SingleTrainer(Trainer):
         # identical to the old per-batch step loop (same rng-fold of
         # state.step), but without a host dispatch per minibatch
         if getattr(self, "_epoch_fn", None) is None:
-            self._epoch_fn = engine.make_epoch_fn(
-                self.model, self.loss, self.tx, metrics=self.metrics,
-                dropout_seed=self.seed)
+            with span("trainer.compile"):
+                self._epoch_fn = engine.make_epoch_fn(
+                    self.model, self.loss, self.tx, metrics=self.metrics,
+                    dropout_seed=self.seed)
         epoch_fn = self._epoch_fn
         staged = None
         device_history = []  # device arrays; fetched once at the end
         for epoch in range(start_epoch, self.num_epoch):
-            chunks, staged = self._epoch_chunk_stream(
-                staged,
-                lambda: (jax.device_put(
-                    {"features": data["features"], "labels": data["labels"]})
-                    for data, _ in tensor.stage_step_chunks(
-                        dataset, self.features_col, self.label_col,
-                        self.batch_size, chunk_steps=self.staging_steps)),
-                resident=self.staging_steps is None)
-            for data in chunks:
-                state, ms = epoch_fn(state, data)
-                device_history.append(ms)
+            with span("trainer.stage"):
+                chunks, staged = self._epoch_chunk_stream(
+                    staged,
+                    lambda: (jax.device_put(
+                        {"features": data["features"],
+                         "labels": data["labels"]})
+                        for data, _ in tensor.stage_step_chunks(
+                            dataset, self.features_col, self.label_col,
+                            self.batch_size, chunk_steps=self.staging_steps)),
+                    resident=self.staging_steps is None)
+            with span("trainer.epoch"):
+                for data in chunks:
+                    state, ms = epoch_fn(state, data)
+                    device_history.append(ms)
             if ckpt is not None:
                 ckpt.save(epoch, {"state": state})
         if ckpt is not None:
             ckpt.wait()
             ckpt.close()
-        self.history = []
-        for ms in device_get_batched(device_history):
-            steps = len(next(iter(ms.values())))
-            self.history.extend({k: float(v[i]) for k, v in ms.items()}
-                                for i in range(steps))
-        self.params = device_get_batched(state.params)
+        with span("trainer.finalize"):
+            self.history = []
+            for ms in device_get_batched(device_history):
+                steps = len(next(iter(ms.values())))
+                self.history.extend({k: float(v[i]) for k, v in ms.items()}
+                                    for i in range(steps))
+            self.params = device_get_batched(state.params)
         self._stop()
         return self.params

@@ -1,0 +1,25 @@
+"""Shims over jax API drift so the framework runs on a range of releases.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+top-level ``jax.shard_map``, renaming ``check_rep`` to ``check_vma``
+along the way. The framework writes the modern spelling everywhere;
+this module backfills it on releases that only ship the experimental
+entry point.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kwargs):
+        return _experimental_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma, **kwargs)
+
+
+__all__ = ["shard_map"]

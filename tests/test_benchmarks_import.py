@@ -1,0 +1,44 @@
+"""Import-smoke every CLI/benchmark module on CPU so tools can't rot
+silently (a bad import would otherwise only surface on the TPU host)."""
+
+import glob
+import importlib
+import importlib.util
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCRIPTS = sorted(glob.glob(os.path.join(REPO, "benchmarks", "*.py")))
+PKG_MODULES = sorted(
+    "distkeras_tpu.benchmarks." + os.path.basename(p)[:-3]
+    for p in glob.glob(os.path.join(REPO, "distkeras_tpu", "benchmarks",
+                                    "*.py"))
+    if os.path.basename(p) != "__init__.py")
+
+
+def test_discovery_found_the_tools():
+    # the floor protects against the glob silently matching nothing
+    assert len(SCRIPTS) >= 5, SCRIPTS
+    assert "distkeras_tpu.benchmarks.run_config" in PKG_MODULES
+
+
+@pytest.mark.parametrize("path", SCRIPTS,
+                         ids=[os.path.basename(p) for p in SCRIPTS])
+def test_import_repo_benchmark_script(path, monkeypatch):
+    """Repo-root benchmarks/ are standalone scripts (no package); load each
+    through its file spec. Every one guards main() under __main__, so
+    importing must be side-effect free and CPU-safe. The script dir goes on
+    sys.path (as `python benchmarks/x.py` would) for sibling imports."""
+    monkeypatch.syspath_prepend(os.path.dirname(path))
+    name = "smoke_" + os.path.basename(path)[:-3]
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert hasattr(mod, "__doc__")
+
+
+@pytest.mark.parametrize("module", PKG_MODULES)
+def test_import_package_benchmark_module(module):
+    assert importlib.import_module(module) is not None

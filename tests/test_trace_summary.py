@@ -1,0 +1,121 @@
+"""benchmarks/trace_summary.py: category aggregation + top-op selection,
+against a synthesized Chrome-trace fixture (the tool was untested)."""
+
+import gzip
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location(
+        "trace_summary", os.path.join(REPO, "benchmarks",
+                                      "trace_summary.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_trace(path, events):
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+def _fixture_events():
+    # device_duration_ps: 1e9 ps == 1 ms in the tool's aggregation
+    return [
+        {"ph": "M", "pid": 7, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 9, "name": "process_name",
+         "args": {"name": "python host"}},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "fusion.1",
+         "args": {"hlo_category": "convolution",
+                  "device_duration_ps": 2_000_000_000,
+                  "model_flops": 1_000_000, "raw_bytes_accessed": 500_000,
+                  "long_name": "%fusion.1 = convolution(...)"}},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "fusion.1",
+         "args": {"hlo_category": "convolution",
+                  "device_duration_ps": 1_000_000_000}},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "copy.2",
+         "args": {"hlo_category": "copy",
+                  "device_duration_ps": 500_000_000}},
+        # the while wrapper double-counts its children: must be skipped
+        {"ph": "X", "pid": 7, "tid": 1, "name": "while.body",
+         "args": {"hlo_category": "while",
+                  "device_duration_ps": 9_000_000_000}},
+        # host-pid op: not a device event, must be filtered
+        {"ph": "X", "pid": 9, "tid": 1, "name": "hostop",
+         "args": {"hlo_category": "convolution",
+                  "device_duration_ps": 123_000_000_000}},
+        # device op without hlo_category (e.g. a marker): filtered
+        {"ph": "X", "pid": 7, "tid": 1, "name": "marker", "args": {}},
+    ]
+
+
+def test_find_trace_file_and_dir(tmp_path):
+    ts = _load_tool()
+    nested = tmp_path / "plugins" / "profile"
+    nested.mkdir(parents=True)
+    old = nested / "a.trace.json.gz"
+    new = nested / "b.trace.json.gz"
+    _write_trace(old, [])
+    _write_trace(new, [])
+    assert ts.find_trace(str(new)) == str(new)
+    assert ts.find_trace(str(tmp_path)) == str(new)  # newest = last sorted
+
+
+def test_find_trace_missing_exits(tmp_path):
+    ts = _load_tool()
+    with pytest.raises(SystemExit):
+        ts.find_trace(str(tmp_path))
+
+
+def test_load_device_events_filters(tmp_path):
+    ts = _load_tool()
+    path = tmp_path / "run.trace.json.gz"
+    _write_trace(path, _fixture_events())
+    events = ts.load_device_events(str(path))
+    names = [e["name"] for e in events]
+    # host-pid and category-less events are out; while wrapper is kept
+    # here (main() skips it during aggregation)
+    assert names == ["fusion.1", "fusion.1", "copy.2", "while.body"]
+
+
+def test_main_aggregation_and_top_ops(tmp_path, monkeypatch, capsys):
+    ts = _load_tool()
+    path = tmp_path / "run.trace.json.gz"
+    _write_trace(path, _fixture_events())
+    monkeypatch.setattr(sys, "argv", ["trace_summary.py", str(path),
+                                      "--top", "1"])
+    ts.main()
+    out = capsys.readouterr().out
+    # totals: convolution 3.00 ms + copy 0.50 ms; while excluded
+    assert "total device op time: 3.50 ms" in out
+    conv_line = next(l for l in out.splitlines()
+                     if l.startswith("convolution"))
+    cols = conv_line.split()
+    assert cols[1] == "3.00"    # summed ms across the two events
+    assert cols[2] == "85.7"    # share of the 3.50 ms total
+    assert "while" not in [l.split()[0] for l in out.splitlines()
+                           if l and not l.startswith(("#", " "))]
+    # --top 1: exactly the heaviest op, with its long_name detail
+    assert "# top 1 ops:" in out
+    top_section = out.split("# top 1 ops:")[1]
+    assert "fusion.1" in top_section
+    assert "copy.2" not in top_section
+    assert "%fusion.1 = convolution(...)" in top_section
+
+
+def test_main_no_device_events_exits(tmp_path, monkeypatch):
+    ts = _load_tool()
+    path = tmp_path / "empty.trace.json.gz"
+    _write_trace(path, [{"ph": "M", "pid": 1, "name": "process_name",
+                         "args": {"name": "/device:TPU:0"}}])
+    monkeypatch.setattr(sys, "argv", ["trace_summary.py", str(path)])
+    with pytest.raises(SystemExit):
+        ts.main()
