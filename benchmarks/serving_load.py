@@ -1,0 +1,208 @@
+"""Serving load generator — latency/throughput for the online engine.
+
+Two standard load models against a ServingEngine (DESIGN.md §7):
+
+- **closed loop**: N client threads each submit one row, wait, repeat —
+  throughput under saturation, and the regime where dynamic batching must
+  beat batch_size=1 submission by >= 4x (ISSUE 2 acceptance; also asserted
+  by tests/test_serving.py). Run for batched vs max_batch_size=1.
+- **open loop**: rows arrive on a Poisson process at an offered rate,
+  independent of completions — the honest latency model (closed loops
+  self-throttle and hide queueing delay). Reports achieved throughput,
+  p50/p95/p99 end-to-end latency, and rejected/timed-out counts per
+  offered load, sweeping rates so the knee is visible.
+
+Usage:
+  python benchmarks/serving_load.py closed [--threads N] [--rows N]
+  python benchmarks/serving_load.py open [--rates r1,r2,...] [--duration S]
+  python benchmarks/serving_load.py all
+
+Prints one JSON line per experiment (same convention as step_probe.py).
+CPU-safe: the model is the BASELINE MNIST MLP; on a TPU host the same
+script exercises the device path unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+try:
+    import distkeras_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # running from a source checkout: use the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+FEATS = 784
+
+
+def _build_engine(**kw):
+    import jax
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.serving import ServingEngine
+
+    model = MLP(features=(256, 128), num_classes=10)
+    params = model.init(jax.random.key(0), jnp.zeros((2, FEATS)),
+                        train=False)["params"]
+    kw.setdefault("buckets", (1, 8, 32, 128))
+    kw.setdefault("max_wait_ms", 1.0)
+    kw.setdefault("queue_capacity", 4096)
+    return ServingEngine(model, params, input_shape=(FEATS,), **kw)
+
+
+def _pcts(lat_s: list) -> dict:
+    if not lat_s:
+        return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
+    a = np.sort(np.asarray(lat_s))
+    pick = lambda q: float(1e3 * a[min(len(a) - 1, int(q * len(a)))])
+    return {"p50_ms": pick(0.50), "p95_ms": pick(0.95), "p99_ms": pick(0.99)}
+
+
+def closed_loop(engine, n_threads: int, rows_per_thread: int) -> dict:
+    """N clients in lock-step submit/wait loops; reports saturation
+    throughput and per-request latency percentiles."""
+    row = np.ones((FEATS,), np.float32)
+    lat: list = []
+    lat_lock = threading.Lock()
+    barrier = threading.Barrier(n_threads + 1)
+
+    def client():
+        mine = []
+        barrier.wait()
+        for _ in range(rows_per_thread):
+            t0 = time.perf_counter()
+            engine.submit(row).result(timeout=300)
+            mine.append(time.perf_counter() - t0)
+        with lat_lock:
+            lat.extend(mine)
+
+    threads = [threading.Thread(target=client) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    n = n_threads * rows_per_thread
+    return {"mode": "closed", "threads": n_threads, "rows": n,
+            "wall_s": round(wall, 4),
+            "rows_per_s": round(n / wall, 1), **_pcts(lat)}
+
+
+def open_loop(engine, offered_rps: float, duration_s: float,
+              timeout_ms: float = 200.0, seed: int = 0) -> dict:
+    """Poisson arrivals at ``offered_rps``, submission never waits for
+    completions; reports achieved goodput + latency + shed/timeout counts
+    at that offered load."""
+    from distkeras_tpu.serving import QueueFull
+
+    rng = np.random.default_rng(seed)
+    row = np.ones((FEATS,), np.float32)
+    inflight: list = []
+    done: list = []  # (latency_s, ok) appended by done-callbacks at the
+    rejected = 0     # moment of completion — NOT at drain time
+    t_start = time.perf_counter()
+    t_next = t_start
+
+    def make_cb(t0):
+        def cb(fut):
+            done.append((time.perf_counter() - t0, fut.exception() is None))
+        return cb
+
+    while True:
+        now = time.perf_counter()
+        if now - t_start >= duration_s:
+            break
+        if now < t_next:
+            time.sleep(min(t_next - now, 0.001))
+            continue
+        t_next += float(rng.exponential(1.0 / offered_rps))
+        try:
+            t0 = time.perf_counter()
+            fut = engine.submit(row, timeout_ms=timeout_ms)
+            fut.add_done_callback(make_cb(t0))
+            inflight.append(fut)
+        except QueueFull:
+            rejected += 1
+    for fut in inflight:  # drain: completion times were already captured
+        try:
+            fut.result(timeout=60)
+        except Exception:
+            pass
+    wall = time.perf_counter() - t_start
+    lat = [d for d, ok in done if ok]
+    return {"mode": "open", "offered_rps": offered_rps,
+            "duration_s": duration_s,
+            "submitted": len(inflight), "rejected": rejected,
+            "timed_out": len(done) - len(lat),
+            "achieved_rps": round(len(lat) / wall, 1), **_pcts(lat)}
+
+
+def run_closed(threads: int, rows: int) -> list:
+    """The acceptance comparison: dynamic batching vs batch_size=1."""
+    results = []
+    batched = _build_engine(max_wait_ms=0.0)
+    single = _build_engine(buckets=(1,), max_batch_size=1, max_wait_ms=0.0)
+    try:
+        closed_loop(batched, 4, 5)  # warm both paths
+        closed_loop(single, 4, 5)
+        fast = closed_loop(batched, threads, rows)
+        fast["engine"] = "dynamic_batching"
+        slow = closed_loop(single, threads, max(1, rows // 8))
+        slow["engine"] = "batch_size_1"
+        speedup = fast["rows_per_s"] / slow["rows_per_s"]
+        results += [fast, slow,
+                    {"mode": "closed", "engine": "speedup",
+                     "dynamic_over_bs1": round(speedup, 2)}]
+    finally:
+        batched.shutdown()
+        single.shutdown()
+    return results
+
+
+def run_open(rates: list, duration_s: float) -> list:
+    results = []
+    engine = _build_engine(max_wait_ms=1.0)
+    try:
+        open_loop(engine, rates[0], min(1.0, duration_s))  # warm
+        for r in rates:
+            results.append(open_loop(engine, r, duration_s))
+    finally:
+        engine.shutdown()
+    return results
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("which", nargs="?", default="all",
+                    choices=("closed", "open", "all"))
+    ap.add_argument("--threads", type=int, default=32)
+    ap.add_argument("--rows", type=int, default=100,
+                    help="closed-loop rows per thread")
+    ap.add_argument("--rates", default="500,2000,8000",
+                    help="open-loop offered rows/s sweep")
+    ap.add_argument("--duration", type=float, default=3.0)
+    args = ap.parse_args(argv)
+
+    results = []
+    if args.which in ("closed", "all"):
+        results += run_closed(args.threads, args.rows)
+    if args.which in ("open", "all"):
+        rates = [float(r) for r in args.rates.split(",") if r]
+        results += run_open(rates, args.duration)
+    for row in results:
+        print(json.dumps(row))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,7 @@ from distkeras_tpu import telemetry
 from distkeras_tpu.data.dataset import Dataset, synthetic_mnist
 from distkeras_tpu.evaluators import AccuracyEvaluator, Evaluator, LossEvaluator
 from distkeras_tpu.predictors import ModelClassifier, ModelPredictor, Predictor
+from distkeras_tpu.serving import ServingEngine
 from distkeras_tpu.transformers import (
     DenseTransformer,
     LabelIndexTransformer,
@@ -59,6 +60,7 @@ __all__ = [
     "PjitTrainer",
     "Predictor",
     "ReshapeTransformer",
+    "ServingEngine",
     "SingleTrainer",
     "Trainer",
     "Transformer",

@@ -50,6 +50,40 @@ def server_for(strategy: Strategy, params) -> ParameterServer:
     return DeltaParameterServer(params)
 
 
+class CadenceTrigger:
+    """Checkpoint cadence on a GLOBALLY counted clock (ADVICE r5 fix).
+
+    ``clock_at_fold`` counts commits from EVERY process, but each process
+    observes it only at its own commits — with P processes a local commit
+    lands on an exact multiple of ``checkpoint_folds`` only ~1/P of the
+    time, so the old ``(clock+1) % folds == 0`` trigger diluted the cadence
+    by ~P. Firing on cadence-interval CROSSING instead — did the observed
+    clock enter a later ``folds``-sized bucket than the last trigger —
+    preserves the knob's meaning (≈ one snapshot per ``folds`` commits) for
+    any observation stride. Thread-safe: concurrent workers observing the
+    same crossing fire exactly once.
+    """
+
+    def __init__(self, folds: int, start_clock: int = 0):
+        if folds < 1:
+            raise ValueError(f"checkpoint_folds must be >= 1, got {folds}")
+        self.folds = int(folds)
+        # commits [0, start_clock) predate this run (resume): their
+        # intervals must not retrigger
+        self._bucket = int(start_clock) // self.folds
+        self._lock = threading.Lock()
+
+    def crossed(self, clock_at_fold: int) -> bool:
+        bucket = (int(clock_at_fold) + 1) // self.folds
+        if bucket <= self._bucket:  # unlocked fast path: no crossing
+            return False
+        with self._lock:
+            if bucket <= self._bucket:
+                return False  # a sibling claimed this crossing first
+            self._bucket = bucket
+            return True
+
+
 def make_window_fn(model, loss, tx, strategy: Strategy, window: int,
                    metric_names: Sequence[str], seed: int):
     """One worker's compiled round: λ local steps + commit computation.
@@ -229,8 +263,7 @@ class HostAsyncRunner:
                         clock_at_fold, clock_at_fold - clock,
                         [{key: float(v[i]) for key, v in ms.items()}
                          for i in range(n)]))
-                    if checkpointing and \
-                            (clock_at_fold + 1) % checkpoint_folds == 0:
+                    if checkpointing and cadence.crossed(clock_at_fold):
                         save_trigger.set()  # non-blocking hand-off
                     fold += 1
             except Exception as e:  # surface thread failures to the caller
@@ -240,6 +273,8 @@ class HostAsyncRunner:
                              # job when a task fails terminally)
 
         checkpointing = checkpointer is not None and checkpoint_folds > 0
+        cadence = (CadenceTrigger(checkpoint_folds, start_clock)
+                   if checkpointing else None)
         saver_thread = None
         if checkpointing:
             saver_thread = threading.Thread(target=saver, daemon=True)
@@ -303,22 +338,34 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
     service = client = None
     try:
         if pid == 0:
-            ps = server_for(runner.strategy,
-                            jax.device_put(init_params, runner.devices[0]))
-            ps.num_updates = int(start_clock)
-            service = rps.ParameterServerService(
-                ps, init_params, expected_processes=jax.process_count(),
-                port=service_port)
-            service.start()
-            rps.share_service_address(service.port)
+            # symmetric go/no-go (ADVICE r5): if service construction fails
+            # here, peers must RAISE at the address broadcast instead of
+            # blocking in it until the collective timeout
+            try:
+                import secrets
+
+                token = secrets.token_hex(16)
+                ps = server_for(
+                    runner.strategy,
+                    jax.device_put(init_params, runner.devices[0]))
+                ps.num_updates = int(start_clock)
+                service = rps.ParameterServerService(
+                    ps, init_params, expected_processes=jax.process_count(),
+                    port=service_port, token=token)
+                service.start()
+            except Exception:
+                rps.share_service_address(None, error=True)
+                raise
+            rps.share_service_address(service.port, token=token)
             local_ps = ps
         else:
-            addr = rps.share_service_address(None)
+            addr, token = rps.share_service_address(None)
             # socket timeout must outlive the history barrier, or a slow
             # pod turns the server's informative barrier-timeout error
             # into a bare client-side socket.timeout
             client = rps.RemoteParameterServer(
-                addr, init_params, timeout=history_timeout + 60.0)
+                addr, init_params, timeout=history_timeout + 60.0,
+                token=token)
             local_ps = client
             # the authoritative start state lives at the center (matters on
             # resume: process 0 restored it; also seeds EASGD replicas)

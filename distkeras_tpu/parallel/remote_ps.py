@@ -51,8 +51,11 @@ from distkeras_tpu.utils.fetch import device_get_batched
 # -- wire format -----------------------------------------------------------
 # [u32 header_len][header JSON (utf-8)][blob 0][blob 1]...
 # header["blob_lens"] carries the byte length of each trailing blob.
+# Public: the serving front-end (distkeras_tpu/serving/server.py) speaks
+# the same framing and the same token scheme.
 
-def _sendall(sock: socket.socket, header: dict, blobs: Sequence[bytes] = ()):
+def send_message(sock: socket.socket, header: dict,
+                 blobs: Sequence[bytes] = ()):
     header = dict(header)
     header["blob_lens"] = [len(b) for b in blobs]
     hb = json.dumps(header).encode()
@@ -69,11 +72,27 @@ def _recvexact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv(sock: socket.socket) -> Tuple[dict, list]:
+def recv_message(sock: socket.socket) -> Tuple[dict, list]:
     (hlen,) = struct.unpack("<I", _recvexact(sock, 4))
     header = json.loads(_recvexact(sock, hlen))
     blobs = [_recvexact(sock, n) for n in header.get("blob_lens", [])]
     return header, blobs
+
+
+_sendall = send_message  # internal aliases, kept for brevity below
+_recv = recv_message
+
+
+def check_token(expected: Optional[str], header: dict) -> bool:
+    """Constant-time shared-token check (ADVICE r5): the service refuses
+    any request whose header token does not match the process-0-generated
+    secret. ``expected=None`` disables authentication (single-host dev)."""
+    if expected is None:
+        return True
+    import hmac
+
+    got = header.get("token")
+    return isinstance(got, str) and hmac.compare_digest(got, expected)
 
 
 class _TreeCodec:
@@ -126,10 +145,12 @@ class ParameterServerService:
 
     def __init__(self, ps: ParameterServer, like,
                  expected_processes: int = 1,
-                 host: str = "0.0.0.0", port: int = 0):
+                 host: str = "0.0.0.0", port: int = 0,
+                 token: Optional[str] = None):
         self.ps = ps
         self.codec = _TreeCodec(like)
         self.expected = int(expected_processes)
+        self.token = token  # ADVICE r5: required in every request header
         self._histories: dict[int, list] = {}
         self._hist_cv = threading.Condition()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -157,6 +178,9 @@ class ParameterServerService:
             t = threading.Thread(target=self._serve, args=(conn,),
                                  daemon=True)
             t.start()
+            # prune finished handlers (ADVICE r5): the list otherwise grows
+            # one entry per connection for the life of the service
+            self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)
 
     def stop(self) -> None:
@@ -177,6 +201,11 @@ class ParameterServerService:
                         header, blobs = _recv(conn)
                     except ConnectionError:
                         return
+                    if not check_token(self.token, header):
+                        telemetry.counter(
+                            "remote_ps.server.auth_failures").inc()
+                        _sendall(conn, {"error": "authentication failed"})
+                        return  # drop the connection, not just the request
                     self._dispatch(conn, header, blobs)
         except Exception:
             if self._running:  # surface handler crashes, don't die silently
@@ -265,9 +294,11 @@ class RemoteParameterServer:
     classes return, so HostAsyncRunner cannot tell the difference.
     """
 
-    def __init__(self, address: str, like, timeout: float = 600.0):
+    def __init__(self, address: str, like, timeout: float = 600.0,
+                 token: Optional[str] = None):
         host, port = address.rsplit(":", 1)
         self.codec = _TreeCodec(like)
+        self.token = token
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -275,6 +306,8 @@ class RemoteParameterServer:
 
     def _roundtrip(self, header: dict, blobs=()) -> Tuple[dict, list]:
         op = header.get("op", "?")
+        if self.token is not None:
+            header = dict(header, token=self.token)
         t0 = time.perf_counter()
         with self._lock:
             _sendall(self._sock, header, blobs)
@@ -330,21 +363,39 @@ class RemoteParameterServer:
         pass
 
 
-def share_service_address(port: Optional[int]) -> str:
-    """Agree on the service address across processes: process 0 broadcasts
-    ``host:port`` (its routable address + the bound port) through a tiny
-    collective; everyone returns the same string."""
+def share_service_address(port: Optional[int],
+                          token: Optional[str] = None,
+                          error: bool = False) -> Tuple[str, Optional[str]]:
+    """Agree on the service address AND auth token across processes:
+    process 0 broadcasts ``host:port|token`` through a tiny collective;
+    everyone returns the same ``(address, token)`` pair.
+
+    ``error=True`` (process 0 only) broadcasts a failure sentinel instead —
+    the symmetric-agreement half of service construction (ADVICE r5): if
+    process 0 could not bring the service up, its peers RAISE here instead
+    of blocking in this broadcast until the collective timeout. Peers raise;
+    process 0 returns a dummy so its own (real) exception propagates.
+    """
     from jax.experimental import multihost_utils
 
     from distkeras_tpu.parallel.distributed import determine_host_address
 
     if jax.process_count() == 1:
-        return f"127.0.0.1:{port}"
-    payload = np.zeros((64,), np.uint8)
+        return f"127.0.0.1:{port}", token
+    payload = np.zeros((192,), np.uint8)
     if jax.process_index() == 0:
-        addr = f"{determine_host_address()}:{port}".encode()
-        if len(addr) > 64:
-            raise ValueError(f"address {addr!r} longer than 64 bytes")
-        payload[:len(addr)] = np.frombuffer(addr, np.uint8)
+        msg = ("!service construction failed on process 0" if error
+               else f"{determine_host_address()}:{port}|{token or ''}")
+        raw = msg.encode()
+        if len(raw) > payload.size:
+            raise ValueError(f"payload {raw!r} longer than "
+                             f"{payload.size} bytes")
+        payload[:len(raw)] = np.frombuffer(raw, np.uint8)
     out = np.asarray(multihost_utils.broadcast_one_to_all(payload))
-    return bytes(out[out != 0]).decode()
+    msg = bytes(out[out != 0]).decode()
+    if msg.startswith("!"):
+        if jax.process_index() == 0:
+            return "", None  # the original exception is already in flight
+        raise RuntimeError(f"parameter service never came up: {msg[1:]}")
+    addr, _, tok = msg.partition("|")
+    return addr, (tok or None)

@@ -30,6 +30,18 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from distkeras_tpu.data.dataset import Dataset
 
 
+def make_forward_fn(model):
+    """The pure inference forward pass: ``(params, x) -> outputs`` with
+    ``train=False``. Shared by the offline predictors here and the online
+    :class:`~distkeras_tpu.serving.ServingEngine`, so batch scoring and
+    live serving compile the SAME computation and cannot drift."""
+
+    def forward(params, x):
+        return model.apply({"params": params}, x, train=False)
+
+    return forward
+
+
 class Predictor:
     """Base predictor: ``predict(dataset) -> dataset + output_col``."""
 
@@ -55,8 +67,7 @@ class ModelPredictor(Predictor):
         self.batch_size = int(batch_size)
         self.mesh = mesh
 
-        def forward(params, x):
-            return model.apply({"params": params}, x, train=False)
+        forward = make_forward_fn(model)
 
         if mesh is not None:
             from distkeras_tpu.parallel import mesh as mesh_lib

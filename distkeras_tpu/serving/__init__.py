@@ -1,0 +1,39 @@
+"""Online serving: dynamic micro-batching inference with bounded compiles.
+
+The offline predictors score Datasets; this package answers individual
+requests at low latency. See engine.py for the pipeline (queue → batcher →
+buckets → executor), server.py for the socket front-end, and DESIGN.md §7
+for semantics and telemetry names.
+
+    from distkeras_tpu.serving import ServingEngine
+
+    eng = ServingEngine(trainer.model, trainer.params, input_shape=(784,),
+                        buckets=(1, 8, 32, 128), max_wait_ms=2.0)
+    fut = eng.submit(row)          # concurrent.futures.Future
+    logits = fut.result()
+    eng.shutdown(drain=True)
+"""
+
+from distkeras_tpu.serving.batching import (
+    DeadlineExceeded,
+    EngineClosed,
+    QueueFull,
+    Request,
+    RequestQueue,
+)
+from distkeras_tpu.serving.buckets import DEFAULT_BUCKETS, BucketSpec
+from distkeras_tpu.serving.engine import ServingEngine
+from distkeras_tpu.serving.server import ServingClient, ServingServer
+
+__all__ = [
+    "BucketSpec",
+    "DEFAULT_BUCKETS",
+    "DeadlineExceeded",
+    "EngineClosed",
+    "QueueFull",
+    "Request",
+    "RequestQueue",
+    "ServingClient",
+    "ServingEngine",
+    "ServingServer",
+]

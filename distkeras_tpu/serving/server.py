@@ -1,0 +1,226 @@
+"""Socket front-end for the ServingEngine — requests over the pod fabric.
+
+Thin by design: the engine owns batching, buckets, deadlines and
+backpressure; this module only moves rows across a socket. It reuses the
+length-prefixed framing AND the shared-token auth scheme of
+``parallel/remote_ps.py`` (ADVICE r5) — one wire convention for the whole
+repo, no pickle, nothing on the wire can execute code.
+
+Protocol (header JSON + raw blobs, see remote_ps):
+
+    {"op": "infer", "token": ..., "shape": [n, ...], "dtype": "float32",
+     "timeout_ms": 50}            + blob: row-major request rows
+    -> {"shape": [n, ...], "dtype": ...} + blob: row-major outputs
+    -> {"error": "...", "kind": "deadline|queue_full|closed|bad_request"}
+
+    {"op": "stats", "token": ...} -> {"counters": {...}, "gauges": {...}}
+    {"op": "ping", "token": ...}  -> {"ok": true}
+
+A request's rows ride the engine's ``submit_many`` (atomic admission:
+either every row is queued or the whole request is rejected with
+``queue_full``), so one TCP client cannot partially starve another.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.parallel.remote_ps import (
+    check_token,
+    recv_message,
+    send_message,
+)
+from distkeras_tpu.serving.batching import (
+    DeadlineExceeded,
+    EngineClosed,
+    QueueFull,
+)
+from distkeras_tpu.serving.engine import ServingEngine
+
+
+def _error_kind(exc: Exception) -> str:
+    if isinstance(exc, DeadlineExceeded):
+        return "deadline"
+    if isinstance(exc, QueueFull):
+        return "queue_full"
+    if isinstance(exc, EngineClosed):
+        return "closed"
+    return "bad_request"
+
+
+class ServingServer:
+    """Accept-loop + handler-thread-per-connection front of a ServingEngine
+    (the reference's parameter-server thread shape, reused a third time).
+
+    ``token``: shared secret required in every request header; None
+    disables auth (loopback dev only — a bound ServingServer otherwise
+    answers anyone who can reach the port).
+    """
+
+    def __init__(self, engine: ServingEngine, host: str = "0.0.0.0",
+                 port: int = 0, token: Optional[str] = None):
+        self.engine = engine
+        self.token = token
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._running = False
+        self._threads: list = []
+
+    def start(self) -> None:
+        self._running = True
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="distkeras-serving-accept")
+        t.start()
+        self._threads.append(t)
+
+    def stop(self, shutdown_engine: bool = False) -> None:
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if shutdown_engine:
+            self.engine.shutdown(drain=True)
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # closed by stop()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket):
+        inflight = telemetry.gauge("serving.server.inflight_connections")
+        inflight.add(1)
+        try:
+            with conn:
+                while True:
+                    try:
+                        header, blobs = recv_message(conn)
+                    except ConnectionError:
+                        return
+                    if not check_token(self.token, header):
+                        telemetry.counter(
+                            "serving.server.auth_failures").inc()
+                        send_message(conn, {"error": "authentication failed",
+                                            "kind": "auth"})
+                        return  # drop the connection, not just the request
+                    self._dispatch(conn, header, blobs)
+        except Exception:
+            if self._running:  # surface handler crashes, don't die silently
+                raise
+        finally:
+            inflight.add(-1)
+
+    def _dispatch(self, conn, header: dict, blobs: list):
+        op = header.get("op")
+        telemetry.counter("serving.server.requests", op=str(op)).inc()
+        if op == "infer":
+            try:
+                self._infer(conn, header, blobs)
+            except Exception as e:
+                send_message(conn, {"error": str(e),
+                                    "kind": _error_kind(e)})
+        elif op == "stats":
+            send_message(conn, self._stats())
+        elif op == "ping":
+            send_message(conn, {"ok": True})
+        else:
+            send_message(conn, {"error": f"unknown op {op!r}",
+                                "kind": "bad_request"})
+
+    def _infer(self, conn, header: dict, blobs: list):
+        if len(blobs) != 1:
+            raise ValueError(f"infer expects 1 blob, got {len(blobs)}")
+        shape = tuple(int(d) for d in header["shape"])
+        x = np.frombuffer(blobs[0],
+                          dtype=np.dtype(header["dtype"])).reshape(shape)
+        if shape[1:] != self.engine.input_shape:
+            raise ValueError(
+                f"rows of shape {shape[1:]} sent to an engine serving "
+                f"{self.engine.input_shape}")
+        timeout_ms = header.get("timeout_ms")
+        futures = self.engine.submit_many(x, timeout_ms=timeout_ms)
+        # wall-clock bound for the blocking result() calls: the per-request
+        # deadline (if any) plus slack for the executing batch to finish
+        wait_s = None if timeout_ms is None else timeout_ms / 1e3 + 30.0
+        rows = [np.asarray(f.result(timeout=wait_s)) for f in futures]
+        out = np.stack(rows) if rows else np.empty((0,), np.float32)
+        send_message(conn, {"shape": list(out.shape), "dtype": str(out.dtype)},
+                     [np.ascontiguousarray(out).tobytes()])
+
+    def _stats(self) -> dict:
+        reg = telemetry.get_registry()
+        if reg is None:
+            return {"counters": {}, "gauges": {}}
+        snap = reg.snapshot()
+        pick = lambda d: {k: v for k, v in d.items()
+                          if k.startswith("serving.")}
+        return {"counters": pick(snap["counters"]),
+                "gauges": pick(snap["gauges"])}
+
+
+class ServingClient:
+    """Blocking client for the serving wire: ``infer(rows) -> outputs``.
+
+    One connection; callers on multiple threads serialize behind a lock
+    (same contention profile as RemoteParameterServer)."""
+
+    def __init__(self, address: str, token: Optional[str] = None,
+                 timeout: float = 60.0):
+        host, port = address.rsplit(":", 1)
+        self.token = token
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def _roundtrip(self, header: dict, blobs=()) -> Tuple[dict, list]:
+        if self.token is not None:
+            header = dict(header, token=self.token)
+        with self._lock:
+            send_message(self._sock, header, blobs)
+            return recv_message(self._sock)
+
+    def infer(self, rows, timeout_ms: Optional[float] = None) -> np.ndarray:
+        x = np.ascontiguousarray(np.asarray(rows))
+        header = {"op": "infer", "shape": list(x.shape),
+                  "dtype": str(x.dtype)}
+        if timeout_ms is not None:
+            header["timeout_ms"] = float(timeout_ms)
+        resp, blobs = self._roundtrip(header, [x.tobytes()])
+        if "error" in resp:
+            raise RuntimeError(
+                f"serving ({resp.get('kind', '?')}): {resp['error']}")
+        return np.frombuffer(blobs[0], np.dtype(resp["dtype"])).reshape(
+            resp["shape"])
+
+    def stats(self) -> dict:
+        resp, _ = self._roundtrip({"op": "stats"})
+        return resp
+
+    def ping(self) -> bool:
+        resp, _ = self._roundtrip({"op": "ping"})
+        if "error" in resp:
+            raise RuntimeError(f"serving: {resp['error']}")
+        return bool(resp.get("ok"))
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

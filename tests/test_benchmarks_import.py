@@ -20,8 +20,10 @@ PKG_MODULES = sorted(
 
 def test_discovery_found_the_tools():
     # the floor protects against the glob silently matching nothing
-    assert len(SCRIPTS) >= 5, SCRIPTS
+    assert len(SCRIPTS) >= 6, SCRIPTS
     assert "distkeras_tpu.benchmarks.run_config" in PKG_MODULES
+    # the serving load generator (ISSUE 2) must be under the smoke glob
+    assert any(os.path.basename(p) == "serving_load.py" for p in SCRIPTS)
 
 
 @pytest.mark.parametrize("path", SCRIPTS,

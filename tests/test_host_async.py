@@ -1,5 +1,7 @@
 """Host-driven true-async mode: live PS, thread workers, real staleness."""
 
+import threading
+
 import numpy as np
 import pytest
 
@@ -271,3 +273,58 @@ def test_sync_mode_rejects_devices_kwarg():
 
     with pytest.raises(ValueError, match="host_async"):
         ADAG(MLP(features=(8,)), num_workers=2, devices=[])
+
+
+def test_checkpoint_cadence_survives_multiprocess_clock_stride():
+    """ADVICE r5 regression: ``clock_at_fold`` counts GLOBAL commits, but a
+    process observes it only at its OWN commits. With P processes the
+    observations stride by ~P, so the old exact-multiple trigger
+    ``(clock+1) % folds == 0`` fired only ~1/P of the time (cadence diluted
+    to ~P*folds). The interval-crossing trigger must fire once per cadence
+    interval for ANY stride."""
+    from distkeras_tpu.parallel.host_async import CadenceTrigger
+
+    folds, stride = 4, 3  # a 3-process pod, viewed from one process
+    # this process's observed commit clocks: every stride-th global clock
+    clocks = list(range(0, 120, stride))
+    trig = CadenceTrigger(folds)
+    fired = [c for c in clocks if trig.crossed(c)]
+    old_rule = [c for c in clocks if (c + 1) % folds == 0]
+    intervals = (clocks[-1] + 1) // folds  # cadence intervals covered
+    # the bug: exact-multiple equality dilutes by ~stride
+    assert len(old_rule) <= intervals // 2
+    # the fix: one trigger per interval crossing (within one of the edge)
+    assert intervals - 1 <= len(fired) <= intervals
+    # at most one fire per interval, strictly increasing buckets
+    buckets = [(c + 1) // folds for c in fired]
+    assert buckets == sorted(set(buckets))
+
+
+def test_checkpoint_cadence_resume_does_not_refire_old_intervals():
+    from distkeras_tpu.parallel.host_async import CadenceTrigger
+
+    trig = CadenceTrigger(4, start_clock=8)  # resumed at clock 8
+    assert not trig.crossed(8)   # clock 8 is inside the already-saved era
+    assert not trig.crossed(9)
+    assert trig.crossed(11)      # first NEW interval boundary fires
+    assert not trig.crossed(11)  # and only once
+
+
+def test_checkpoint_cadence_concurrent_workers_fire_once():
+    """Two workers observing the same crossing must produce one trigger."""
+    from distkeras_tpu.parallel.host_async import CadenceTrigger
+
+    trig = CadenceTrigger(2)
+    fires = []
+
+    def worker():
+        for c in range(0, 100):
+            if trig.crossed(c):
+                fires.append((c + 1) // 2)
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert sorted(fires) == sorted(set(fires))  # no double-fire anywhere

@@ -191,3 +191,54 @@ def test_dead_service_mid_run_raises_cleanly():
     with pytest.raises(OSError):
         runner.run(params, [shards] * 3, ps=cli, fetch_final=False)
     assert killed.is_set()
+
+
+def test_token_authentication_rejects_and_drops_bad_clients():
+    """ADVICE r5: with a token configured, a request carrying no/a wrong
+    token gets an error AND loses its connection; the right token works."""
+    ps = DeltaParameterServer(jax.device_put(PARAMS))
+    svc = ParameterServerService(ps, PARAMS, token="s3cret")
+    svc.start()
+    try:
+        good = RemoteParameterServer(f"127.0.0.1:{svc.port}", PARAMS,
+                                     token="s3cret")
+        _, clock = good.pull()
+        assert clock == 0
+        good.close()
+        for bad_token in (None, "wrong"):
+            bad = RemoteParameterServer(f"127.0.0.1:{svc.port}", PARAMS,
+                                        token=bad_token)
+            with pytest.raises(RuntimeError, match="authentication"):
+                bad.pull()
+            with pytest.raises((ConnectionError, OSError)):
+                bad.pull()  # server hung up after the auth failure
+            bad.close()
+    finally:
+        svc.stop()
+
+
+def test_handler_threads_are_pruned():
+    """ADVICE r5: the per-connection handler list must not grow one entry
+    per connection forever (reconnect-heavy clients would leak)."""
+    import time as _time
+
+    ps, svc = _service()
+    try:
+        for _ in range(10):
+            cli = RemoteParameterServer(f"127.0.0.1:{svc.port}", PARAMS)
+            cli.num_updates  # one roundtrip so the handler really ran
+            cli.close()
+        # pruning happens at accept time: keep poking with fresh
+        # connections until the dead handlers have exited and been pruned
+        deadline = _time.time() + 5
+        while _time.time() < deadline:
+            cli = RemoteParameterServer(f"127.0.0.1:{svc.port}", PARAMS)
+            cli.num_updates
+            n = len(svc._threads)
+            cli.close()
+            if n <= 3:  # accept loop + live handler + slack
+                break
+            _time.sleep(0.05)
+        assert n <= 3, svc._threads
+    finally:
+        svc.stop()
