@@ -1,0 +1,244 @@
+"""Comms benchmark — bytes-on-wire, codec latency, and overlap throughput.
+
+Three experiments against the PS comms path (DESIGN.md §8):
+
+- **codecs**: encode/decode every registered wire codec over a realistic
+  delta pytree (a ResNet-18 parameter tree's worth of float leaves) and
+  report bytes on the wire, compression ratio vs raw, and per-direction
+  encode/decode time. The int8 path must show >= 3x bytes reduction on
+  float32 leaves (PR acceptance; asserted by tests/test_comms.py).
+- **loopback**: a real ParameterServerService on 127.0.0.1 with a
+  RemoteParameterServer client per codec — commit/pull wall-clock latency
+  and actual bytes sent/received (from the comms.* telemetry counters),
+  i.e. the serialization + socket cost a cross-process worker pays.
+- **overlap**: end-to-end window throughput of HostAsyncRunner with the
+  serialized loop vs the double-buffered loop (overlap=True), against a
+  PS whose pull/commit carry an injected RTT — the regime (remote PS)
+  the comms thread exists for. Overlapped must beat serialized.
+
+Usage:
+  python benchmarks/comms_bench.py codecs  [--model resnet18|mlp]
+  python benchmarks/comms_bench.py loopback [--reps N]
+  python benchmarks/comms_bench.py overlap [--rtt-ms MS] [--rounds N]
+  python benchmarks/comms_bench.py all
+
+Prints one JSON line per experiment (same convention as serving_load.py).
+CPU-safe; on a TPU host the same script exercises the device path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+try:
+    import distkeras_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # running from a source checkout: use the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _delta_tree(model_name: str):
+    """A parameter-shaped pytree of small float deltas — what a DOWNPOUR
+    worker actually commits (window-summed gradient steps, magnitude
+    ~learning_rate * grads)."""
+    import jax
+    import jax.numpy as jnp
+
+    if model_name == "resnet18":
+        from distkeras_tpu.models.resnet import resnet18
+
+        model = resnet18(num_classes=10)
+        params = model.init(jax.random.key(0),
+                            jnp.zeros((1, 32, 32, 3)), train=False)["params"]
+    else:
+        from distkeras_tpu.models.mlp import MLP
+
+        model = MLP(features=(256, 128), num_classes=10)
+        params = model.init(jax.random.key(0),
+                            jnp.zeros((2, 784)))["params"]
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    rng = np.random.default_rng(0)
+    deltas = [np.asarray(rng.normal(0.0, 0.01, l.shape), np.asarray(l).dtype)
+              if np.issubdtype(np.asarray(l).dtype, np.floating)
+              else np.asarray(l) for l in leaves]
+    return jax.tree_util.tree_unflatten(treedef, deltas)
+
+
+def bench_codecs(model_name: str = "resnet18", reps: int = 5) -> list:
+    import jax
+
+    from distkeras_tpu import comms
+
+    delta = _delta_tree(model_name)
+    leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(delta)]
+    specs = [(l.shape, l.dtype) for l in leaves]
+    raw_bytes = sum(l.nbytes for l in leaves)
+    rows = []
+    for name in comms.available_codecs():
+        codec = comms.get_codec(name)
+        # warm-up + timing: encode/decode the full tree `reps` times
+        enc_s = dec_s = 0.0
+        wire = 0
+        max_err = 0.0
+        for r in range(reps):
+            t0 = time.perf_counter()
+            blobs = [codec.encode(l, kind="commit") for l in leaves]
+            enc_s += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            out = [codec.decode(bytes(b), s, d, kind="commit")
+                   for b, (s, d) in zip(blobs, specs)]
+            dec_s += time.perf_counter() - t0
+            if r == 0:
+                wire = sum(len(b) for b in blobs)
+                max_err = max(
+                    float(np.max(np.abs(np.asarray(o, np.float32)
+                                        - np.asarray(l, np.float32))))
+                    if np.issubdtype(l.dtype, np.floating) else 0.0
+                    for o, l in zip(out, leaves))
+        row = {
+            "bench": "codecs", "model": model_name, "codec": name,
+            "leaves": len(leaves), "raw_bytes": raw_bytes,
+            "wire_bytes": wire, "ratio": round(raw_bytes / wire, 3),
+            "encode_ms": round(enc_s / reps * 1e3, 3),
+            "decode_ms": round(dec_s / reps * 1e3, 3),
+            "max_abs_err": max_err,
+        }
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+    return rows
+
+
+def bench_loopback(reps: int = 20, model_name: str = "mlp") -> list:
+    """Commit/pull latency + true bytes-on-wire through a real socket."""
+    import jax
+
+    from distkeras_tpu import comms, telemetry
+    from distkeras_tpu.parallel import remote_ps as rps
+    from distkeras_tpu.parameter_servers import DeltaParameterServer
+
+    delta = _delta_tree(model_name)
+    rows = []
+    for name in comms.available_codecs():
+        params = jax.tree.map(np.copy, delta)
+        service = rps.ParameterServerService(
+            DeltaParameterServer(params), params, token="bench")
+        service.start()
+        client = rps.RemoteParameterServer(
+            f"127.0.0.1:{service.port}", params, token="bench", codec=name)
+        sent0 = telemetry.counter("comms.bytes_sent", op="commit",
+                                  side="client").value
+        try:
+            commit_s, pull_s = [], []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                _, clock = client.pull()
+                pull_s.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                client.commit(delta, last_update=clock)
+                commit_s.append(time.perf_counter() - t0)
+            sent = telemetry.counter("comms.bytes_sent", op="commit",
+                                     side="client").value - sent0
+        finally:
+            client.close()
+            service.stop()
+        row = {
+            "bench": "loopback", "model": model_name, "codec": name,
+            "negotiated": client.negotiated, "reps": reps,
+            "commit_bytes_per_rep": int(sent // reps),
+            "commit_ms_p50": round(float(np.median(commit_s)) * 1e3, 3),
+            "pull_ms_p50": round(float(np.median(pull_s)) * 1e3, 3),
+        }
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+    return rows
+
+
+class _DelayedPS:
+    """Wrap a local PS with an injected per-op RTT — a stand-in for a
+    cross-host parameter service, so the overlap benchmark measures the
+    comms-thread win without needing two processes."""
+
+    def __init__(self, ps, rtt_s: float):
+        self.ps, self.rtt_s = ps, rtt_s
+
+    def pull(self):
+        time.sleep(self.rtt_s)
+        return self.ps.pull()
+
+    def commit(self, delta, last_update=0):
+        time.sleep(self.rtt_s)
+        return self.ps.commit(delta, last_update=last_update)
+
+    @property
+    def num_updates(self):
+        return self.ps.num_updates
+
+
+def bench_overlap(rtt_ms: float = 5.0, rounds: int = 24,
+                  window: int = 4) -> list:
+    import jax
+    import optax
+
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.parallel import host_async, strategies
+    from distkeras_tpu.parameter_servers import DeltaParameterServer
+
+    model = MLP(features=(64,), num_classes=10)
+    params = model.init(jax.random.key(0), np.zeros((8, 32)))["params"]
+    rng = np.random.default_rng(0)
+    eye = np.eye(10, dtype=np.float32)
+    shards = [[{"features": rng.normal(size=(window, 8, 32)).astype("f4"),
+                "labels": eye[rng.integers(0, 10, size=(window, 8))]}
+               for _ in range(rounds)]]
+    rows = []
+    for overlap in (False, True):
+        runner = host_async.HostAsyncRunner(
+            model, "categorical_crossentropy", optax.sgd(0.05),
+            strategies.get("downpour", learning_rate=0.05), window,
+            seed=0, overlap=overlap)
+        ps = _DelayedPS(DeltaParameterServer(
+            jax.device_put(params, runner.devices[0])), rtt_ms / 1e3)
+        t0 = time.perf_counter()
+        runner.run(params, [shards], ps=ps)
+        dt = time.perf_counter() - t0
+        row = {
+            "bench": "overlap", "overlap": overlap, "rtt_ms": rtt_ms,
+            "rounds": rounds, "window": window,
+            "wall_s": round(dt, 3),
+            "windows_per_s": round(rounds / dt, 2),
+        }
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+    if rows[1]["windows_per_s"] > rows[0]["windows_per_s"]:
+        speedup = rows[1]["windows_per_s"] / rows[0]["windows_per_s"]
+        print(json.dumps({"bench": "overlap", "speedup": round(speedup, 3)}),
+              flush=True)
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("which", choices=("codecs", "loopback", "overlap",
+                                      "all"))
+    ap.add_argument("--model", default="resnet18",
+                    choices=("resnet18", "mlp"))
+    ap.add_argument("--reps", type=int, default=20)
+    ap.add_argument("--rtt-ms", type=float, default=5.0)
+    ap.add_argument("--rounds", type=int, default=24)
+    args = ap.parse_args(argv)
+    if args.which in ("codecs", "all"):
+        bench_codecs(args.model)
+    if args.which in ("loopback", "all"):
+        bench_loopback(args.reps)
+    if args.which in ("overlap", "all"):
+        bench_overlap(args.rtt_ms, args.rounds)
+
+
+if __name__ == "__main__":
+    main()

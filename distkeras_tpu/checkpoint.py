@@ -118,12 +118,13 @@ class Checkpointer:
 
 
 def save_params(path: str, params) -> None:
-    """One-shot params save (Keras model.save() analogue) — npz, no Orbax dir
-    layout, convenient for small models and interchange."""
+    """One-shot params save (Keras model.save() analogue) — flat container,
+    no Orbax dir layout, convenient for small models and interchange. Leaves
+    stream to the file as chunked views (no whole-tree join)."""
     from distkeras_tpu.utils import serialization as ser
 
     with open(path, "wb") as f:
-        f.write(ser.serialize_params(params))
+        ser.write_params(f, params)
 
 
 def load_params(path: str, like=None):

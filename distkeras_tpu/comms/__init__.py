@@ -1,0 +1,35 @@
+"""Wire codecs + chunked transport for the parameter-server path.
+
+The async PS algorithms (DOWNPOUR/ADAG/DynSGD/EASGD) are bounded by the
+commit/pull wire: full-precision leaf bytes per round-trip. This package
+makes the wire pluggable — cast-on-wire (f16/bf16) and int8 affine
+quantization with worker-side error feedback (QSGD, Alistarh et al. 2017;
+DGC, Lin et al. 2018) — and provides chunked zero-copy buffer encoding so
+large leaves never pay a full-tree copy on the way out.
+"""
+
+from distkeras_tpu.comms.chunking import (
+    DEFAULT_CHUNK_BYTES,
+    iter_chunks,
+    leaf_buffer,
+    send_buffers,
+)
+from distkeras_tpu.comms.codec import (
+    Bf16Codec,
+    Codec,
+    EncodedParameterServer,
+    ErrorFeedback,
+    Fp16Codec,
+    QuantCodec,
+    RawCodec,
+    available_codecs,
+    get_codec,
+    negotiate,
+)
+
+__all__ = [
+    "Codec", "RawCodec", "Fp16Codec", "Bf16Codec", "QuantCodec",
+    "ErrorFeedback", "EncodedParameterServer",
+    "get_codec", "available_codecs", "negotiate",
+    "leaf_buffer", "iter_chunks", "send_buffers", "DEFAULT_CHUNK_BYTES",
+]

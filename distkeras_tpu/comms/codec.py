@@ -1,0 +1,325 @@
+"""Wire codecs for the parameter-server path: pluggable leaf encodings.
+
+A :class:`Codec` turns one pytree leaf (a numpy array whose shape/dtype
+both ends agreed on out of band) into a self-contained wire blob and back.
+Blobs carry their own per-leaf metadata inline (quantization scale/offset
+as a fixed-size prefix), so the message framing stays "a list of blobs" —
+no header schema changes per codec.
+
+Implementations:
+
+- :class:`RawCodec` — native bytes, exact (today's behavior).
+- :class:`Fp16Codec` / :class:`Bf16Codec` — cast-on-wire for float leaves,
+  2x reduction; decode casts back to the leaf's native dtype.
+- :class:`QuantCodec` — per-leaf int8 affine quantization (~4x on f32
+  leaves). Lossy, so commits must run through :class:`ErrorFeedback`: the
+  quantization error of every commit is kept worker-side and re-injected
+  into the next delta instead of being lost (QSGD/DGC error feedback —
+  the cumulative folded update tracks the true update stream). Center
+  pulls have no accumulation to feed errors back into, so QuantCodec
+  ships pulls as f16 casts rather than quantizing absolute weights.
+
+Direction matters: ``kind="commit"`` encodes deltas (worker -> server),
+``kind="pull"`` encodes the center (server -> worker). Both ends pass the
+same ``kind`` for a given message, so no per-blob tag is needed.
+
+Integer/bool leaves pass through raw under every codec — quantizing a
+step counter would corrupt it silently.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.comms.chunking import leaf_buffer
+
+Spec = Tuple[tuple, np.dtype]  # (shape, dtype) agreed out of band
+
+
+def _is_float(dtype) -> bool:
+    # np.floating covers f16/f32/f64; ml_dtypes extensions (bf16, fp8)
+    # register as void-kind with a float name, so match by name too
+    dt = np.dtype(dtype)
+    return np.issubdtype(dt, np.floating) or dt.name.startswith(
+        ("bfloat", "float8", "float4", "float6"))
+
+
+def _from_bytes(blob, dtype, shape) -> np.ndarray:
+    arr = np.frombuffer(blob, dtype=dtype)
+    if arr.size != int(np.prod(shape)):
+        raise ValueError(
+            f"blob of {arr.size} elements does not match leaf shape {shape}")
+    return arr.reshape(shape)
+
+
+class Codec:
+    """Leaf codec protocol. Stateless: one instance serves every
+    connection/thread (the stateful half — error feedback — lives in
+    :class:`ErrorFeedback`)."""
+
+    name = "abstract"
+    #: True when decode(encode(x)) != x in general; lossy commit paths must
+    #: run through ErrorFeedback.
+    lossy = False
+
+    def encode(self, arr: np.ndarray, kind: str = "commit"):
+        """Array -> bytes-like wire blob (zero-copy where exactness
+        allows)."""
+        raise NotImplementedError
+
+    def decode(self, blob, shape, dtype, kind: str = "commit") -> np.ndarray:
+        """Wire blob -> array of exactly (shape, dtype)."""
+        raise NotImplementedError
+
+
+class RawCodec(Codec):
+    """Native bytes on the wire — exact, and zero-copy on encode."""
+
+    name = "raw"
+
+    def encode(self, arr, kind: str = "commit"):
+        return leaf_buffer(arr)
+
+    def decode(self, blob, shape, dtype, kind: str = "commit"):
+        return _from_bytes(blob, dtype, shape)
+
+
+class _CastCodec(Codec):
+    """Float leaves cross the wire in a narrower float dtype."""
+
+    lossy = True
+    wire_dtype: np.dtype
+
+    def encode(self, arr, kind: str = "commit"):
+        if not _is_float(arr.dtype):
+            return leaf_buffer(arr)
+        return leaf_buffer(np.asarray(arr, dtype=self.wire_dtype))
+
+    def decode(self, blob, shape, dtype, kind: str = "commit"):
+        if not _is_float(dtype):
+            return _from_bytes(blob, dtype, shape)
+        wire = _from_bytes(blob, self.wire_dtype, shape)
+        return np.asarray(wire, dtype=dtype)
+
+
+class Fp16Codec(_CastCodec):
+    name = "f16"
+    wire_dtype = np.dtype(np.float16)
+
+
+class Bf16Codec(_CastCodec):
+    name = "bf16"
+
+    @property
+    def wire_dtype(self):
+        import ml_dtypes  # registered by jax; local import keeps this
+                          # module importable without it until bf16 is used
+        return np.dtype(ml_dtypes.bfloat16)
+
+
+class QuantCodec(Codec):
+    """Per-leaf int8 affine quantization for commits; f16 casts for pulls.
+
+    Commit blob layout: ``[f32 scale][f32 lo][uint8 payload]`` — decode is
+    ``lo + scale * q``. Scale spans the leaf's own [min, max], so the
+    per-element error is bounded by ``(max - min) / 255`` (asserted in
+    tests/test_comms.py). A constant leaf encodes with scale 0 and decodes
+    exactly.
+    """
+
+    name = "int8"
+    lossy = True
+    _LEVELS = 255
+    _pull = Fp16Codec()
+
+    def encode(self, arr, kind: str = "commit"):
+        if not _is_float(arr.dtype):
+            return leaf_buffer(arr)
+        if kind == "pull":
+            return self._pull.encode(arr, kind)
+        a = np.asarray(arr, dtype=np.float32).reshape(-1)
+        if a.size == 0:
+            return b""
+        lo, hi = float(a.min()), float(a.max())
+        scale = (hi - lo) / self._LEVELS
+        if scale > 0.0:
+            q = np.clip(np.rint((a - lo) / scale), 0, self._LEVELS)
+        else:
+            q = np.zeros_like(a)
+        head = np.array([scale, lo], dtype="<f4").tobytes()
+        return head + q.astype(np.uint8).tobytes()
+
+    def decode(self, blob, shape, dtype, kind: str = "commit"):
+        if not _is_float(dtype):
+            return _from_bytes(blob, dtype, shape)
+        if kind == "pull":
+            return self._pull.decode(blob, shape, dtype, kind)
+        n = int(np.prod(shape))
+        if n == 0:
+            return np.zeros(shape, dtype)
+        if len(blob) != 8 + n:
+            raise ValueError(
+                f"int8 blob of {len(blob)} bytes does not match leaf "
+                f"shape {shape} (want {8 + n})")
+        scale, lo = np.frombuffer(blob[:8], dtype="<f4")
+        q = np.frombuffer(blob, dtype=np.uint8, offset=8)
+        return (np.float32(lo) + np.float32(scale)
+                * q.astype(np.float32)).reshape(shape).astype(dtype)
+
+
+_REGISTRY: Dict[str, Codec] = {
+    c.name: c for c in (RawCodec(), Fp16Codec(), Bf16Codec(), QuantCodec())
+}
+
+
+def available_codecs() -> tuple:
+    return tuple(sorted(_REGISTRY))
+
+
+def get_codec(codec) -> Codec:
+    """Resolve a codec by name (or pass a Codec instance through)."""
+    if isinstance(codec, Codec):
+        return codec
+    try:
+        return _REGISTRY[str(codec)]
+    except KeyError:
+        raise ValueError(f"Unknown codec {codec!r}; "
+                         f"available: {available_codecs()}") from None
+
+
+def negotiate(requested: str, supported: Iterable[str]) -> str:
+    """Handshake rule shared by both ends: the server grants the requested
+    codec when it supports it, otherwise both sides fall back to raw (raw
+    is always legal — it is the seed wire format)."""
+    return requested if requested in set(supported) | {"raw"} else "raw"
+
+
+class ErrorFeedback:
+    """Worker-side residual accumulation for lossy commit codecs.
+
+    ``encode_leaves`` adjusts each float delta by the residual left over
+    from previous encodes, encodes the adjusted value, and banks the new
+    quantization error: over a run, the sum of what the server decoded
+    equals the sum of the true deltas to within one step's quantization
+    error — the error-feedback invariant (tests/test_comms.py asserts it).
+
+    Thread-safe: host_async worker threads share one client and therefore
+    one residual stream; the lock serializes adjust+bank so no delta's
+    error is dropped or double-injected.
+    """
+
+    def __init__(self, codec: Codec):
+        self.codec = get_codec(codec)
+        self._residual: Optional[List[Optional[np.ndarray]]] = None
+        self._lock = threading.Lock()
+
+    def encode_leaves(self, leaves: Sequence[np.ndarray],
+                      specs: Sequence[Spec]) -> list:
+        if not self.codec.lossy:
+            return [self.codec.encode(l, kind="commit") for l in leaves]
+        with self._lock:
+            if self._residual is None:
+                self._residual = [
+                    np.zeros(s, np.float32) if _is_float(d) else None
+                    for s, d in specs]
+            blobs = []
+            for i, (leaf, (shape, dtype)) in enumerate(zip(leaves, specs)):
+                res = self._residual[i]
+                if res is None:  # integer leaf: exact under every codec
+                    blobs.append(self.codec.encode(leaf, kind="commit"))
+                    continue
+                adj = np.asarray(leaf, np.float32) + res
+                blob = self.codec.encode(adj, kind="commit")
+                decoded = np.asarray(
+                    self.codec.decode(bytes(blob), shape, dtype,
+                                      kind="commit"), np.float32)
+                self._residual[i] = adj - decoded
+                blobs.append(blob)
+            return blobs
+
+    def reset(self) -> None:
+        with self._lock:
+            self._residual = None
+
+
+class EncodedParameterServer:
+    """Wrap a local ParameterServer so every pull/commit crosses the codec
+    exactly as it would on the wire — no socket required.
+
+    Two users: single-process ``codec=`` runs (the trainer sees the same
+    numerics it would get against a remote service, so convergence tests
+    don't need a loopback socket), and process 0 of a cross-process run
+    (its workers hit the PS object directly; wrapping keeps their commits
+    subject to the same lossy transform as every remote process's).
+    """
+
+    def __init__(self, ps, codec):
+        self.ps = ps
+        self.codec = get_codec(codec)
+        self._ef = ErrorFeedback(self.codec)
+        self._specs: Optional[List[Spec]] = None
+        self._treedef = None
+
+    def _flatten(self, tree):
+        import jax
+
+        from distkeras_tpu.utils.fetch import device_get_batched
+
+        leaves, treedef = jax.tree_util.tree_flatten(
+            device_get_batched(tree))
+        leaves = [np.asarray(l) for l in leaves]
+        if self._specs is None:
+            self._specs = [(l.shape, l.dtype) for l in leaves]
+            self._treedef = treedef
+        return leaves
+
+    def _roundtrip(self, tree, kind: str):
+        import jax
+
+        leaves = self._flatten(tree)
+        if kind == "commit":
+            blobs = self._ef.encode_leaves(leaves, self._specs)
+        else:
+            blobs = [self.codec.encode(l, kind=kind) for l in leaves]
+        raw = sum(l.nbytes for l in leaves)
+        wire = sum(len(b) for b in blobs)
+        if wire:
+            telemetry.histogram("comms.compress_ratio",
+                                op=kind, path="local").record(raw / wire)
+        out = [self.codec.decode(bytes(b), s, d, kind=kind)
+               for b, (s, d) in zip(blobs, self._specs)]
+        return jax.tree_util.tree_unflatten(self._treedef, out)
+
+    # -- ParameterServer interface ---------------------------------------
+    def pull(self):
+        center, clock = self.ps.pull()
+        if self.codec.name == "raw":
+            return center, clock
+        return self._roundtrip(center, "pull"), clock
+
+    def commit(self, delta, last_update: int = 0) -> int:
+        if self.codec.name == "raw":
+            return self.ps.commit(delta, last_update=last_update)
+        return self.ps.commit(self._roundtrip(delta, "commit"),
+                              last_update=last_update)
+
+    def initialize(self, params) -> None:
+        self.ps.initialize(params)
+
+    @property
+    def num_updates(self) -> int:
+        return self.ps.num_updates
+
+    @num_updates.setter
+    def num_updates(self, value: int) -> None:
+        self.ps.num_updates = value
+
+    def start(self) -> None:
+        self.ps.start()
+
+    def stop(self) -> None:
+        self.ps.stop()

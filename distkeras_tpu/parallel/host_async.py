@@ -24,6 +24,7 @@ of pickled TCP.
 
 from __future__ import annotations
 
+import queue as queue_lib
 import threading
 import time
 from typing import Any, Optional, Sequence
@@ -32,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from distkeras_tpu import engine, telemetry
+from distkeras_tpu import comms, engine, telemetry
 from distkeras_tpu.data.prefetch import prefetch
 from distkeras_tpu.utils.fetch import device_get_batched
 from distkeras_tpu.parameter_servers import (
@@ -133,7 +134,8 @@ class HostAsyncRunner:
 
     def __init__(self, model, loss, tx, strategy: Strategy, window: int,
                  metrics: Sequence[str] = (), seed: int = 0,
-                 devices: Optional[Sequence[jax.Device]] = None):
+                 devices: Optional[Sequence[jax.Device]] = None,
+                 codec: Optional[str] = None, overlap: bool = False):
         self.strategy = strategy
         self.window = int(window)
         self.window_fn = make_window_fn(model, loss, tx, strategy, window,
@@ -141,6 +143,17 @@ class HostAsyncRunner:
         self.tx = tx
         # worker k runs on devices[k % D]; default = single-device mode
         self.devices = list(devices) if devices else [jax.devices()[0]]
+        # wire codec for the PS exchange. With a runner-created (local) PS
+        # a non-raw codec wraps it in EncodedParameterServer so commits and
+        # pulls see exactly the wire numerics; with an injected ps= the
+        # caller owns the codec (run_cross_process negotiates it per
+        # connection).
+        self.codec = None if codec is None \
+            else comms.get_codec(codec)
+        # overlap=True double-buffers each worker: the previous window's
+        # commit and the next window's pull run on a per-worker comms
+        # thread while the current window computes (see _overlapped_rounds)
+        self.overlap = bool(overlap)
         self.worker_devices: list = []  # actual placement, for tests/logs
         self.window_clocks: list = []   # merged commit clocks, last run
         self.merged_windows: list = []  # (clock, staleness, steps) tuples
@@ -177,6 +190,15 @@ class HostAsyncRunner:
             ps = server_for(self.strategy,
                             jax.device_put(init_params, self.devices[0]))
             ps.num_updates = int(start_clock)
+            if self.codec is not None and self.codec.name != "raw":
+                # single-process codec run: every pull/commit crosses the
+                # codec exactly as it would on the wire
+                ps = comms.EncodedParameterServer(ps, self.codec)
+        # snapshots and the final fetch read the center EXACTLY — a lossy
+        # wire codec must not round the saved/returned params, only the
+        # worker exchange
+        base_ps = getattr(ps, "ps", ps) \
+            if isinstance(ps, comms.EncodedParameterServer) else ps
         # per-window records: (commit_clock, staleness, [per-step metrics])
         windows: list[list[tuple]] = [[] for _ in range(num_workers)]
         errors: list = []
@@ -199,7 +221,8 @@ class HostAsyncRunner:
                         return
                     else:
                         continue
-                    center, clock = ps.pull()  # consistent under the PS lock
+                    # consistent under the PS lock
+                    center, clock = base_ps.pull()
                     if clock > last_saved:
                         t0 = time.perf_counter()
                         checkpointer.save(
@@ -229,7 +252,6 @@ class HostAsyncRunner:
                                             worker=wid)
                 carry = jax.device_put(
                     self.strategy.init_carry(init_params, self.tx), dev)
-                fold = 0
 
                 def staged_rounds():
                     # device placement runs on the prefetch thread one
@@ -239,6 +261,25 @@ class HostAsyncRunner:
                         for batches in shards[k]:
                             yield jax.device_put(batches, dev)
 
+                def bookkeep(clock_at_fold: int, pull_clock: int, ms):
+                    # commits the center absorbed between this worker's
+                    # pull and its own fold — real scheduling staleness
+                    lag_h.record(clock_at_fold - pull_clock)
+                    ms = device_get_batched(ms)
+                    n = len(ms["loss"])
+                    windows[k].append((
+                        clock_at_fold, clock_at_fold - pull_clock,
+                        [{key: float(v[i]) for key, v in ms.items()}
+                         for i in range(n)]))
+                    if checkpointing and cadence.crossed(clock_at_fold):
+                        save_trigger.set()  # non-blocking hand-off
+
+                if self.overlap:
+                    self._overlapped_rounds(
+                        k, wid, dev, carry, ps, staged_rounds(), abort,
+                        bookkeep, pull_h, win_h, commit_h)
+                    return
+                fold = 0
                 for batches in prefetch(staged_rounds(), depth=1):
                     if abort.is_set():
                         return  # a sibling died: stop wasting windows
@@ -254,17 +295,7 @@ class HostAsyncRunner:
                     win_h.record(t2 - t1)
                     clock_at_fold = ps.commit(commit, last_update=clock)
                     commit_h.record(time.perf_counter() - t2)
-                    # commits the center absorbed between this worker's
-                    # pull and its own fold — real scheduling staleness
-                    lag_h.record(clock_at_fold - clock)
-                    ms = device_get_batched(ms)
-                    n = len(ms["loss"])
-                    windows[k].append((
-                        clock_at_fold, clock_at_fold - clock,
-                        [{key: float(v[i]) for key, v in ms.items()}
-                         for i in range(n)]))
-                    if checkpointing and cadence.crossed(clock_at_fold):
-                        save_trigger.set()  # non-blocking hand-off
+                    bookkeep(clock_at_fold, clock, ms)
                     fold += 1
             except Exception as e:  # surface thread failures to the caller
                 errors.append(e)
@@ -302,8 +333,87 @@ class HostAsyncRunner:
             # barrier instead; skipping here saves a redundant full-params
             # transfer (+ a clock roundtrip) per remote process
             return None, history, stal, -1
-        center, _ = ps.pull()
+        center, _ = base_ps.pull()
         return device_get_batched(center), history, stal, ps.num_updates
+
+    def _overlapped_rounds(self, k, wid, dev, carry, ps, rounds, abort,
+                           bookkeep, pull_h, win_h, commit_h):
+        """Double-buffered worker loop: while window n computes, a
+        per-worker comms thread commits window n-1 and pulls the center
+        for window n+1. Hides commit+pull latency behind compute — the
+        win that matters when the PS is remote (remote_ps.py) or the
+        codec makes encode/decode non-trivial.
+
+        Semantics: the center a window consumes is one window OLDER with
+        respect to the worker's OWN commits than in the serialized loop
+        (center for window n+1 is pulled before commit n folds). Clocks
+        stay exact — staleness is measured from the actual pull/commit
+        clock pair, so the histogram reflects the extra self-staleness
+        rather than hiding it; CadenceTrigger still fires on true fold
+        clocks (one window later in this worker's observation stride).
+        """
+        _STOP = object()
+        req: queue_lib.Queue = queue_lib.Queue(maxsize=1)
+        resp: queue_lib.Queue = queue_lib.Queue(maxsize=1)
+
+        def comms_loop():
+            # one request in flight at a time: commit the finished window
+            # (if any), then pull the next center. Exceptions travel to
+            # the compute loop through the resp queue.
+            try:
+                while True:
+                    item = req.get()
+                    if item is _STOP:
+                        return
+                    commit, pull_clock = item
+                    clock_at_fold = -1
+                    if commit is not None:
+                        t0 = time.perf_counter()
+                        clock_at_fold = ps.commit(commit,
+                                                  last_update=pull_clock)
+                        commit_h.record(time.perf_counter() - t0)
+                    t0 = time.perf_counter()
+                    center, clock = ps.pull()
+                    pull_h.record(time.perf_counter() - t0)
+                    resp.put((center, clock, clock_at_fold))
+            except Exception as e:
+                resp.put(e)
+
+        ct = threading.Thread(target=comms_loop, daemon=True,
+                              name=f"host-async-comms-{wid}")
+        ct.start()
+        try:
+            req.put((None, 0))  # prime: pull window 0's center
+            fold = 0
+            pending = None  # (pull_clock, ms) awaiting its fold clock
+            for batches in prefetch(rounds, depth=1):
+                if abort.is_set():
+                    return  # a sibling died: stop wasting windows
+                got = resp.get()
+                if isinstance(got, Exception):
+                    raise got
+                center, clock, clock_at_fold = got
+                if pending is not None:
+                    # the previous window's commit has now folded; its
+                    # clock arrived with this response
+                    bookkeep(clock_at_fold, pending[0], pending[1])
+                t1 = time.perf_counter()
+                carry, commit, ms = self.window_fn(
+                    carry, jax.device_put(center, dev), batches,
+                    np.int32(wid * 1_000_003 + fold))
+                jax.block_until_ready(commit)
+                win_h.record(time.perf_counter() - t1)
+                pending = (clock, ms)
+                req.put((commit, clock))
+                fold += 1
+            if pending is not None:
+                got = resp.get()  # drain the final window's commit
+                if isinstance(got, Exception):
+                    raise got
+                bookkeep(got[2], pending[0], pending[1])
+        finally:
+            req.put(_STOP)
+            ct.join()
 
 
 def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
@@ -358,6 +468,11 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
                 raise
             rps.share_service_address(service.port, token=token)
             local_ps = ps
+            if runner.codec is not None and runner.codec.name != "raw":
+                # process 0's workers skip the socket but must see the
+                # SAME wire numerics as remote peers, or convergence
+                # depends on which process a worker landed on
+                local_ps = comms.EncodedParameterServer(ps, runner.codec)
         else:
             addr, token = rps.share_service_address(None)
             # socket timeout must outlive the history barrier, or a slow
@@ -365,7 +480,8 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
             # into a bare client-side socket.timeout
             client = rps.RemoteParameterServer(
                 addr, init_params, timeout=history_timeout + 60.0,
-                token=token)
+                token=token,
+                codec="raw" if runner.codec is None else runner.codec.name)
             local_ps = client
             # the authoritative start state lives at the center (matters on
             # resume: process 0 restored it; also seeds EASGD replicas)

@@ -33,6 +33,7 @@ matching the sync path's process-transparency.
 
 from __future__ import annotations
 
+import collections
 import json
 import socket
 import struct
@@ -43,7 +44,7 @@ from typing import Any, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-from distkeras_tpu import telemetry
+from distkeras_tpu import comms, telemetry
 from distkeras_tpu.parameter_servers import ParameterServer
 from distkeras_tpu.utils.fetch import device_get_batched
 
@@ -53,13 +54,23 @@ from distkeras_tpu.utils.fetch import device_get_batched
 # header["blob_lens"] carries the byte length of each trailing blob.
 # Public: the serving front-end (distkeras_tpu/serving/server.py) speaks
 # the same framing and the same token scheme.
+#
+# Blob CONTENT is codec-dependent (comms/codec.py): a connection starts on
+# the raw codec and may switch after a {"op": "hello", "codec": ...}
+# handshake — the server grants the request when it supports that codec and
+# answers with the accepted name (fallback: "raw"), after which both ends
+# encode/decode every pull/commit blob through it.
 
 def send_message(sock: socket.socket, header: dict,
-                 blobs: Sequence[bytes] = ()):
+                 blobs: Sequence = ()):
+    """Frame and send. Blobs may be bytes or memoryviews; large ones go out
+    as bounded chunks straight from their backing arrays (no whole-message
+    join — the old ``b"".join`` copied every leaf a second time)."""
     header = dict(header)
     header["blob_lens"] = [len(b) for b in blobs]
     hb = json.dumps(header).encode()
-    sock.sendall(b"".join([struct.pack("<I", len(hb)), hb, *blobs]))
+    sock.sendall(struct.pack("<I", len(hb)) + hb)
+    comms.send_buffers(sock, blobs)
 
 
 def _recvexact(sock: socket.socket, n: int) -> bytes:
@@ -96,40 +107,66 @@ def check_token(expected: Optional[str], header: dict) -> bool:
 
 
 class _TreeCodec:
-    """Flatten/unflatten a fixed pytree structure to raw leaf bytes.
+    """Flatten/unflatten a fixed pytree structure to wire leaf blobs.
 
     Both ends construct the codec from their own (identically-initialized)
-    params tree, so the wire carries only leaf bytes — structure, shapes
-    and dtypes are agreed out of band and VERIFIED on decode.
+    params tree, so the wire carries only leaf blobs — structure, shapes
+    and dtypes are agreed out of band and VERIFIED on decode. The per-leaf
+    encoding is delegated to a pluggable wire codec (comms/codec.py,
+    default raw); lossy codecs get a worker-side error-feedback accumulator
+    so commit quantization error re-enters the next delta instead of being
+    lost.
     """
 
-    def __init__(self, like):
+    def __init__(self, like, wire="raw"):
         host = jax.tree.map(np.asarray, device_get_batched(like))
         leaves, self.treedef = jax.tree_util.tree_flatten(host)
         self.specs = [(l.shape, l.dtype) for l in leaves]
+        self._raw_bytes = sum(
+            int(np.prod(s)) * np.dtype(d).itemsize for s, d in self.specs)
+        self.set_wire(wire)
 
-    def encode(self, tree) -> list:
-        leaves = jax.tree_util.tree_flatten(
-            jax.tree.map(np.asarray, device_get_batched(tree)))[0]
+    def set_wire(self, wire) -> None:
+        self.wire = comms.get_codec(wire)
+        self._ef = comms.ErrorFeedback(self.wire) if self.wire.lossy \
+            else None
+
+    def with_wire(self, wire) -> "_TreeCodec":
+        """A sibling sharing the (immutable) specs/treedef with its own
+        wire codec + error-feedback state — per-connection codecs on the
+        server without re-flattening ``like`` per accept."""
+        clone = object.__new__(_TreeCodec)
+        clone.treedef = self.treedef
+        clone.specs = self.specs
+        clone._raw_bytes = self._raw_bytes
+        clone.set_wire(wire)
+        return clone
+
+    def encode(self, tree, kind: str = "commit") -> list:
+        leaves = [np.asarray(l) for l in jax.tree_util.tree_flatten(
+            device_get_batched(tree))[0]]
         if len(leaves) != len(self.specs):
             raise ValueError(
                 f"tree has {len(leaves)} leaves, codec expects "
                 f"{len(self.specs)}")
-        return [np.ascontiguousarray(l).tobytes() for l in leaves]
+        if self._ef is not None and kind == "commit":
+            blobs = self._ef.encode_leaves(leaves, self.specs)
+        else:
+            blobs = [self.wire.encode(l, kind=kind) for l in leaves]
+        wire_bytes = sum(len(b) for b in blobs)
+        if wire_bytes:
+            telemetry.histogram("comms.compress_ratio", op=kind,
+                                codec=self.wire.name).record(
+                self._raw_bytes / wire_bytes)
+        return blobs
 
-    def decode(self, blobs: Sequence[bytes]):
+    def decode(self, blobs: Sequence[bytes], kind: str = "commit"):
         if len(blobs) != len(self.specs):
             raise ValueError(
                 f"message has {len(blobs)} blobs, codec expects "
                 f"{len(self.specs)}")
-        leaves = []
-        for b, (shape, dtype) in zip(blobs, self.specs):
-            arr = np.frombuffer(b, dtype=dtype)
-            if arr.size != int(np.prod(shape)):
-                raise ValueError(
-                    f"blob of {arr.size} elements does not match leaf "
-                    f"shape {shape}")
-            leaves.append(arr.reshape(shape))
+        leaves = [self.wire.decode(b, shape, dtype, kind=kind)
+                  for b, (shape, dtype) in zip(blobs, self.specs)]
         return jax.tree_util.tree_unflatten(self.treedef, leaves)
 
 
@@ -146,9 +183,14 @@ class ParameterServerService:
     def __init__(self, ps: ParameterServer, like,
                  expected_processes: int = 1,
                  host: str = "0.0.0.0", port: int = 0,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None,
+                 codecs: Optional[Sequence[str]] = None):
         self.ps = ps
         self.codec = _TreeCodec(like)
+        # wire codecs this server will grant in the hello handshake
+        # (None = everything registered); raw is always granted
+        self.supported = tuple(codecs) if codecs is not None \
+            else comms.available_codecs()
         self.expected = int(expected_processes)
         self.token = token  # ADVICE r5: required in every request header
         self._histories: dict[int, list] = {}
@@ -194,6 +236,7 @@ class ParameterServerService:
     def _serve(self, conn: socket.socket):
         inflight = telemetry.gauge("remote_ps.server.inflight_connections")
         inflight.add(1)
+        codec = self.codec  # per-connection: hello may swap the wire codec
         try:
             with conn:
                 while True:
@@ -206,41 +249,63 @@ class ParameterServerService:
                             "remote_ps.server.auth_failures").inc()
                         _sendall(conn, {"error": "authentication failed"})
                         return  # drop the connection, not just the request
-                    self._dispatch(conn, header, blobs)
+                    if header["op"] == "hello":
+                        granted = comms.negotiate(
+                            header.get("codec", "raw"), self.supported)
+                        codec = self.codec.with_wire(granted)
+                        telemetry.counter("comms.negotiated",
+                                          codec=granted).inc()
+                        _sendall(conn, {"codec": granted})
+                        continue
+                    self._dispatch(conn, header, blobs, codec)
         except Exception:
             if self._running:  # surface handler crashes, don't die silently
                 raise
         finally:
             inflight.add(-1)
 
-    def _dispatch(self, conn, header: dict, blobs: list):
+    def _dispatch(self, conn, header: dict, blobs: list,
+                  codec: Optional[_TreeCodec] = None):
         op = header["op"]
         telemetry.counter("remote_ps.server.dispatch", op=op).inc()
         telemetry.counter("remote_ps.server.bytes_received").inc(
             sum(len(b) for b in blobs))
+        telemetry.counter("comms.bytes_recv", op=op, side="server").inc(
+            sum(len(b) for b in blobs))
         t0 = time.perf_counter()
         try:
-            self._dispatch_op(conn, op, header, blobs)
+            self._dispatch_op(conn, op, header, blobs,
+                              codec if codec is not None else self.codec)
         finally:
             telemetry.histogram("remote_ps.server.handle_s",
                                 op=op).record(time.perf_counter() - t0)
 
-    def _dispatch_op(self, conn, op: str, header: dict, blobs: list):
+    @staticmethod
+    def _reply(conn, op: str, header: dict, blobs: Sequence = ()):
+        telemetry.counter("comms.bytes_sent", op=op, side="server").inc(
+            sum(len(b) for b in blobs))
+        _sendall(conn, header, blobs)
+
+    def _dispatch_op(self, conn, op: str, header: dict, blobs: list,
+                     codec: _TreeCodec):
         if op == "pull":
             center, clock = self.ps.pull()
-            _sendall(conn, {"clock": clock}, self.codec.encode(center))
+            self._reply(conn, op, {"clock": clock},
+                        codec.encode(center, kind="pull"))
         elif op == "commit":
-            delta = self.codec.decode(blobs)
+            # decode ONCE into the leaves' native dtypes; the PS folds the
+            # decoded tree directly (no second materialization)
+            delta = codec.decode(blobs, kind="commit")
             at_fold = self.ps.commit(delta,
                                      last_update=header["last_update"])
-            _sendall(conn, {"at_fold": at_fold})
+            self._reply(conn, op, {"at_fold": at_fold})
         elif op == "clock":
-            _sendall(conn, {"clock": self.ps.pull()[1]})
+            self._reply(conn, op, {"clock": self.ps.pull()[1]})
         elif op == "history_put":
             with self._hist_cv:
                 self._histories[int(header["pid"])] = header["windows"]
                 self._hist_cv.notify_all()
-            _sendall(conn, {"ok": True})
+            self._reply(conn, op, {"ok": True})
         elif op == "history_get":
             # blocks until EVERY process uploaded — the end-of-run barrier
             with self._hist_cv:
@@ -256,8 +321,8 @@ class ParameterServerService:
                     (w for ws in self._histories.values() for w in ws),
                     key=lambda w: w[0])
             center, clock = self.ps.pull()
-            _sendall(conn, {"windows": merged, "clock": clock},
-                     self.codec.encode(center))
+            self._reply(conn, op, {"windows": merged, "clock": clock},
+                        codec.encode(center, kind="pull"))
         else:
             _sendall(conn, {"error": f"unknown op {op!r}"})
 
@@ -287,31 +352,66 @@ class ParameterServerService:
 class RemoteParameterServer:
     """Client drop-in for the ParameterServer interface over the service.
 
-    One connection per process; worker threads share it behind a lock, so
-    a process's pulls/commits serialize on the wire (their windows still
-    overlap in compute) — the same contention profile as the reference's
-    per-executor socket. ``pull``/``commit`` return exactly what the local
-    classes return, so HostAsyncRunner cannot tell the difference.
+    One data connection per process; worker threads share it PIPELINED:
+    the connection lock covers only the send, and responses are claimed in
+    send order by a FIFO of waiters — so a worker's request goes on the
+    wire as soon as the previous request finished *sending*, not after its
+    full round-trip (the old full-RPC lock made every small request pay
+    the largest in-flight commit's RTT, and vice versa). Control-plane ops
+    (``num_updates`` polls) ride a separate lazily-opened connection with
+    its own server handler thread, so they can never head-of-line-block —
+    or be blocked by — a multi-megabyte commit. ``pull``/``commit`` return
+    exactly what the local classes return, so HostAsyncRunner cannot tell
+    the difference.
+
+    ``codec=`` requests a wire codec in the hello handshake; the server
+    answers with what it granted (``.negotiated``; falls back to "raw"
+    when the server lacks the codec). Lossy codecs apply error feedback
+    to commits inside the tree codec (comms/codec.py).
     """
 
     def __init__(self, address: str, like, timeout: float = 600.0,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None, codec: str = "raw"):
         host, port = address.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._timeout = timeout
         self.codec = _TreeCodec(like)
         self.token = token
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=timeout)
+        self._sock = socket.create_connection(self._addr, timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._recv_cv = threading.Condition()
+        self._pending: collections.deque = collections.deque()
+        self._ctrl_sock: Optional[socket.socket] = None
+        self._ctrl_lock = threading.Lock()
+        self.negotiated = "raw"
+        if comms.get_codec(codec).name != "raw":
+            resp, _ = self._roundtrip({"op": "hello",
+                                       "codec": comms.get_codec(codec).name})
+            self.negotiated = resp["codec"]
+            self.codec.set_wire(self.negotiated)
 
     def _roundtrip(self, header: dict, blobs=()) -> Tuple[dict, list]:
         op = header.get("op", "?")
         if self.token is not None:
             header = dict(header, token=self.token)
         t0 = time.perf_counter()
-        with self._lock:
+        ticket = object()
+        with self._send_lock:
+            # enqueue BEFORE releasing the send lock: wire order and
+            # waiter order must agree or responses would cross-match
             _sendall(self._sock, header, blobs)
+            with self._recv_cv:
+                self._pending.append(ticket)
+        with self._recv_cv:
+            while self._pending[0] is not ticket:
+                self._recv_cv.wait()
+        try:
             resp, rblobs = _recv(self._sock)
+        finally:
+            with self._recv_cv:
+                self._pending.popleft()
+                self._recv_cv.notify_all()
         # rtt includes the wait for the shared connection: the contention
         # profile of the one-socket-per-process design is part of what a
         # STALENESS round wants to see
@@ -321,23 +421,45 @@ class RemoteParameterServer:
             sum(len(b) for b in blobs))
         telemetry.counter("remote_ps.client.bytes_received").inc(
             sum(len(b) for b in rblobs))
+        telemetry.counter("comms.bytes_sent", op=op, side="client").inc(
+            sum(len(b) for b in blobs))
+        telemetry.counter("comms.bytes_recv", op=op, side="client").inc(
+            sum(len(b) for b in rblobs))
         if "error" in resp:
             raise RuntimeError(f"parameter service: {resp['error']}")
         return resp, rblobs
 
+    def _control_roundtrip(self, header: dict) -> dict:
+        """Small blob-free ops on a dedicated connection (opened on first
+        use): a clock poll answers in one small-packet RTT even while the
+        data connection is mid-way through a large commit."""
+        if self.token is not None:
+            header = dict(header, token=self.token)
+        with self._ctrl_lock:
+            if self._ctrl_sock is None:
+                self._ctrl_sock = socket.create_connection(
+                    self._addr, timeout=self._timeout)
+                self._ctrl_sock.setsockopt(socket.IPPROTO_TCP,
+                                           socket.TCP_NODELAY, 1)
+            _sendall(self._ctrl_sock, header)
+            resp, _ = _recv(self._ctrl_sock)
+        if "error" in resp:
+            raise RuntimeError(f"parameter service: {resp['error']}")
+        return resp
+
     def pull(self):
         resp, blobs = self._roundtrip({"op": "pull"})
-        return self.codec.decode(blobs), resp["clock"]
+        return self.codec.decode(blobs, kind="pull"), resp["clock"]
 
     def commit(self, delta: Any, last_update: int = 0) -> int:
         resp, _ = self._roundtrip(
             {"op": "commit", "last_update": int(last_update)},
-            self.codec.encode(delta))
+            self.codec.encode(delta, kind="commit"))
         return resp["at_fold"]
 
     @property
     def num_updates(self) -> int:
-        return self._roundtrip({"op": "clock"})[0]["clock"]
+        return self._control_roundtrip({"op": "clock"})["clock"]
 
     def put_history(self, pid: int, windows: list) -> None:
         self._roundtrip({"op": "history_put", "pid": int(pid),
@@ -347,13 +469,17 @@ class RemoteParameterServer:
     def get_history(self, timeout: float = 600):
         resp, blobs = self._roundtrip({"op": "history_get",
                                        "timeout": timeout})
-        return (resp["windows"], self.codec.decode(blobs), resp["clock"])
+        return (resp["windows"], self.codec.decode(blobs, kind="pull"),
+                resp["clock"])
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        for sock in (self._sock, self._ctrl_sock):
+            if sock is None:
+                continue
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     # reference lifecycle no-ops (parity with ParameterServer)
     def start(self) -> None:

@@ -30,7 +30,6 @@ import jax
 import jax.numpy as jnp
 
 from distkeras_tpu import telemetry
-from distkeras_tpu.utils.trees import tree_add, tree_scale
 
 
 class ParameterServer:
@@ -89,7 +88,12 @@ class ParameterServer:
 
 @jax.jit
 def _fold(center, delta, weight):
-    return tree_add(center, tree_scale(delta, weight))
+    # cast each scaled delta leaf back to its center leaf's dtype: a wire
+    # codec may deliver deltas in a lower precision (f16/bf16 decode), and
+    # without the cast jnp type promotion would silently migrate the center
+    # to a different dtype after the first such fold
+    return jax.tree.map(
+        lambda c, d: c + (weight * d).astype(c.dtype), center, delta)
 
 
 class DeltaParameterServer(ParameterServer):

@@ -284,6 +284,8 @@ class DistributedTrainer(Trainer):
                  data_layout: str = "replicated",
                  devices=None,
                  telemetry_path: Optional[str] = None,
+                 codec: str = "raw",
+                 comms_overlap: bool = False,
                  **strategy_kwargs):
         super().__init__(model, loss, worker_optimizer, learning_rate,
                          metrics, features_col, label_col, batch_size,
@@ -367,6 +369,18 @@ class DistributedTrainer(Trainer):
             raise ValueError(
                 "host_async mode requires an exchanging strategy "
                 "(DOWNPOUR/ADAG/DynSGD/AEASGD/EAMSGD)")
+        # wire codec for the PS exchange + comms/compute overlap — both are
+        # host_async knobs (the sync path's psum never serializes params)
+        from distkeras_tpu import comms as comms_lib
+
+        comms_lib.get_codec(codec)  # validate the name EARLY (fail at
+                                    # construction, not first commit)
+        if mode != "host_async" and (codec != "raw" or comms_overlap):
+            raise ValueError(
+                "codec/comms_overlap tune the host_async parameter-server "
+                "exchange; sync mode folds commits in-graph (no wire)")
+        self.codec = codec
+        self.comms_overlap = bool(comms_overlap)
         self.num_updates = 0
         self.staleness_history: list[float] = []
 
@@ -807,7 +821,8 @@ class DistributedTrainer(Trainer):
                 self._async_runner = host_async.HostAsyncRunner(
                     self.model, self.loss, self.tx, self.strategy,
                     self.communication_window, self.metrics, self.seed,
-                    devices=self.devices or jax.local_devices())
+                    devices=self.devices or jax.local_devices(),
+                    codec=self.codec, overlap=self.comms_overlap)
         runner = self._async_runner
         folds = (self.checkpoint_folds or self.num_workers) \
             if ckpt is not None else 0

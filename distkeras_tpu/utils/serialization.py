@@ -4,8 +4,18 @@ Reference parity: ``serialize_keras_model`` / ``deserialize_keras_model`` in
 ``distkeras/utils.py`` (unverified, mount empty) pack a Keras model as
 architecture JSON + weight arrays and ship it through pickle to executors.
 Here the architecture is a flax module (reconstructed from its constructor
-kwargs) and the weights are a pytree saved via a stable .npz encoding — no
-pickle on any wire, and the bytes are portable across hosts/processes.
+kwargs) and the weights are a pytree saved in a flat container of
+path-encoded names + raw little-endian leaf bytes — no pickle on any wire,
+and the bytes are portable across hosts/processes.
+
+Container v2 (magic ``DKTP2\\0``): a JSON manifest of (key, shape, dtype)
+triples followed by the leaves' raw bytes. It replaced the original .npz
+encoding for two reasons: npz silently degrades ml_dtypes leaves (a bf16
+array comes back as an anonymous ``V2`` void dtype — the round-trip loses
+the dtype, see tests/test_serialization.py), and the BytesIO zip path
+copies the whole tree twice. v2 round-trips every fixed-itemsize dtype
+bit-exactly and streams leaf buffers zero-copy (comms/chunking.py); v1
+.npz blobs remain readable (``deserialize_params`` sniffs the magic).
 """
 
 from __future__ import annotations
@@ -19,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 _SEP = "/"
+_MAGIC = b"DKTP2\x00"
 
 
 def _flatten_with_paths(tree) -> dict[str, np.ndarray]:
@@ -37,19 +48,77 @@ def _path_key(entry) -> str:
     return str(entry)
 
 
-def serialize_params(params) -> bytes:
-    """Pytree of arrays -> npz bytes with path-encoded names."""
+def _dtype_by_name(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # ml_dtypes names (bfloat16, float8_*) resolve only once the
+        # extension dtypes are registered; jax imports ml_dtypes, but be
+        # explicit so a bare-numpy reader of the blob still works
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def param_buffers(params) -> Tuple[bytes, list]:
+    """Container v2 as (manifest+header bytes, zero-copy leaf buffers) —
+    the streaming form: callers frame/write the buffers without joining
+    them (checkpoint.py writes them straight to the file)."""
+    from distkeras_tpu.comms.chunking import leaf_buffer
+
     flat = _flatten_with_paths(params)
-    buf = io.BytesIO()
-    np.savez(buf, **flat)
-    return buf.getvalue()
+    manifest = [{"key": k, "shape": list(v.shape), "dtype": v.dtype.name}
+                for k, v in flat.items()]
+    mb = json.dumps({"leaves": manifest}).encode()
+    header = _MAGIC + len(mb).to_bytes(8, "little") + mb
+    return header, [leaf_buffer(v) for v in flat.values()]
+
+
+def write_params(fileobj, params) -> int:
+    """Stream a params tree to a file object (v2 container); returns the
+    byte count. One header allocation; leaves go out as chunked views."""
+    from distkeras_tpu.comms.chunking import write_buffers
+
+    header, buffers = param_buffers(params)
+    fileobj.write(header)
+    return len(header) + write_buffers(fileobj, buffers)
+
+
+def serialize_params(params) -> bytes:
+    """Pytree of arrays -> v2 container bytes with path-encoded names."""
+    header, buffers = param_buffers(params)
+    return b"".join([header, *buffers])
+
+
+def _load_v2(data: bytes) -> dict[str, np.ndarray]:
+    n = int.from_bytes(data[len(_MAGIC):len(_MAGIC) + 8], "little")
+    body = len(_MAGIC) + 8
+    manifest = json.loads(data[body:body + n].decode())
+    flat: dict[str, np.ndarray] = {}
+    off = body + n
+    for leaf in manifest["leaves"]:
+        dt = _dtype_by_name(leaf["dtype"])
+        shape = tuple(leaf["shape"])
+        size = int(np.prod(shape)) * dt.itemsize
+        flat[leaf["key"]] = np.frombuffer(
+            data, dtype=dt, count=int(np.prod(shape)),
+            offset=off).reshape(shape)
+        off += size
+    if off != len(data):
+        raise ValueError(f"params container is {len(data)} bytes but the "
+                         f"manifest accounts for {off}")
+    return flat
 
 
 def deserialize_params(data: bytes, like=None):
-    """npz bytes -> pytree. With ``like`` given, restores that exact
-    treedef (and device placement stays host-side until the caller puts it)."""
-    with np.load(io.BytesIO(data)) as npz:
-        flat = {k: npz[k] for k in npz.files}
+    """Container bytes -> pytree (v2, with v1 .npz fallback). With ``like``
+    given, restores that exact treedef (and device placement stays
+    host-side until the caller puts it)."""
+    if data[:len(_MAGIC)] == _MAGIC:
+        flat = _load_v2(data)
+    else:  # v1 blobs (pre-codec checkpoints) are zip archives
+        with np.load(io.BytesIO(data)) as npz:
+            flat = {k: npz[k] for k in npz.files}
     if like is None:
         # Rebuild a nested dict from path keys.
         out: dict[str, Any] = {}

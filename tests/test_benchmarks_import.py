@@ -20,10 +20,12 @@ PKG_MODULES = sorted(
 
 def test_discovery_found_the_tools():
     # the floor protects against the glob silently matching nothing
-    assert len(SCRIPTS) >= 6, SCRIPTS
+    assert len(SCRIPTS) >= 7, SCRIPTS
     assert "distkeras_tpu.benchmarks.run_config" in PKG_MODULES
     # the serving load generator (ISSUE 2) must be under the smoke glob
     assert any(os.path.basename(p) == "serving_load.py" for p in SCRIPTS)
+    # the comms benchmark (ISSUE 3) too
+    assert any(os.path.basename(p) == "comms_bench.py" for p in SCRIPTS)
 
 
 @pytest.mark.parametrize("path", SCRIPTS,
@@ -44,3 +46,33 @@ def test_import_repo_benchmark_script(path, monkeypatch):
 @pytest.mark.parametrize("module", PKG_MODULES)
 def test_import_package_benchmark_module(module):
     assert importlib.import_module(module) is not None
+
+
+def _load_comms_bench():
+    path = os.path.join(REPO, "benchmarks", "comms_bench.py")
+    spec = importlib.util.spec_from_file_location("comms_bench_run", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_comms_bench_int8_bytes_reduction():
+    """PR 3 acceptance (fast variant): the int8 codec must cut bytes on
+    the wire by >= 3x vs raw on a float32 delta pytree."""
+    rows = _load_comms_bench().bench_codecs("mlp", reps=1)
+    by = {r["codec"]: r for r in rows}
+    assert by["int8"]["ratio"] >= 3.0, by["int8"]
+    assert by["raw"]["ratio"] == 1.0
+
+
+@pytest.mark.slow
+def test_comms_bench_full_sweep_resnet():
+    """PR 3 acceptance (full variant): the ResNet-18 delta pytree through
+    every codec, plus the loopback-socket and overlap-throughput runs."""
+    mod = _load_comms_bench()
+    rows = mod.bench_codecs("resnet18", reps=2)
+    by = {r["codec"]: r for r in rows}
+    assert by["int8"]["ratio"] >= 3.0, by["int8"]
+    mod.bench_loopback(reps=5)
+    over = mod.bench_overlap(rtt_ms=5.0, rounds=16)
+    assert over[1]["windows_per_s"] > over[0]["windows_per_s"], over

@@ -242,3 +242,80 @@ def test_handler_threads_are_pruned():
         assert n <= 3, svc._threads
     finally:
         svc.stop()
+
+
+def test_sends_pipeline_on_shared_connection(monkeypatch):
+    """Regression for the split send/recv: a second worker's request must
+    go on the wire while the first worker's response is still outstanding.
+    The old full-RPC lock held the connection for the whole round-trip, so
+    the second send waited out the first pull's server-side latency."""
+    import time
+
+    from distkeras_tpu.parallel import remote_ps as rps
+
+    class SlowPullPS(DeltaParameterServer):
+        def pull(self):
+            time.sleep(0.4)  # a fat center crossing a slow wire
+            return super().pull()
+
+    ps = SlowPullPS(jax.device_put(PARAMS))
+    svc = ParameterServerService(ps, PARAMS)
+    svc.start()
+    sent = []
+    real = rps._sendall
+
+    def spy(sock, header, blobs=()):
+        if "op" in header:  # client requests only (replies carry no op)
+            sent.append((header["op"], time.perf_counter()))
+        return real(sock, header, blobs)
+
+    monkeypatch.setattr(rps, "_sendall", spy)
+    cli = RemoteParameterServer(f"127.0.0.1:{svc.port}", PARAMS)
+    try:
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=cli.pull) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert [op for op, _ in sent] == ["pull", "pull"]
+        # both sends left within the FIRST pull's service time; under the
+        # old design the second send waited for the full first round-trip
+        assert max(ts for _, ts in sent) - t0 < 0.3, sent
+    finally:
+        cli.close()
+        svc.stop()
+
+
+def test_clock_poll_not_blocked_by_slow_commit():
+    """num_updates rides a dedicated control connection: it must answer
+    while the data connection is mid-way through a slow commit (the
+    head-of-line block the split exists to remove)."""
+    import time
+
+    class SlowFoldPS(DeltaParameterServer):
+        def commit(self, delta, last_update=0):
+            time.sleep(0.5)
+            return super().commit(delta, last_update=last_update)
+
+    ps = SlowFoldPS(jax.device_put(PARAMS))
+    svc = ParameterServerService(ps, PARAMS)
+    svc.start()
+    cli = RemoteParameterServer(f"127.0.0.1:{svc.port}", PARAMS)
+    try:
+        delta = {"w": np.full((4, 3), 0.5, np.float32),
+                 "b": np.ones((3,), np.float32)}
+        committer = threading.Thread(
+            target=lambda: cli.commit(delta, last_update=0))
+        committer.start()
+        time.sleep(0.1)  # the commit is now inside the slow server fold
+        t0 = time.perf_counter()
+        clock = cli.num_updates
+        dt = time.perf_counter() - t0
+        committer.join()
+        assert dt < 0.3, f"clock poll took {dt:.3f}s behind a slow commit"
+        assert clock == 0  # polled BEFORE the commit folded
+        assert cli.num_updates == 1  # and the commit did land
+    finally:
+        cli.close()
+        svc.stop()
