@@ -1,0 +1,135 @@
+"""Probe the live health plane against a real loopback training run.
+
+The end-to-end demo of DESIGN.md §9: start a small DOWNPOUR host-async run
+whose parameter server sits behind a loopback
+:class:`~distkeras_tpu.parallel.remote_ps.ParameterServerService`, then —
+while the workers are committing — poll the service's introspection
+endpoints from this process exactly as the ``health.cli`` poller would,
+printing one status line per poll and a final snapshot digest (worker
+heartbeats, staleness, straggler verdicts, PS counters).
+
+Usage:
+  python benchmarks/health_probe.py [--workers 4] [--epochs 3]
+                                    [--interval 0.2] [--prom]
+
+``--prom`` additionally dumps the final metrics snapshot in Prometheus
+text format (the same bytes `health.cli metrics --format prom` serves
+live). CPU-safe: the model is the baseline MNIST MLP on synthetic data.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import secrets
+import sys
+import threading
+import time
+
+try:
+    import distkeras_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # running from a source checkout: use the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def run_probe(n: int = 2048, workers: int = 4, window: int = 4,
+              batch: int = 16, epochs: int = 3,
+              interval: float = 0.2) -> dict:
+    """Run the loopback training + polling loop; returns
+    ``{"polls": [status dicts], "snapshot": final snapshot}``."""
+    import jax
+    import jax.numpy as jnp
+
+    from distkeras_tpu import DOWNPOUR, synthetic_mnist
+    from distkeras_tpu.health.cli import _watch_line
+    from distkeras_tpu.health.endpoints import HealthClient
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.parallel import host_async, remote_ps
+
+    model = MLP(features=(32,), num_classes=10)
+    # the trainer is only the convenient factory for (tx, strategy)
+    t = DOWNPOUR(model, mode="host_async", num_workers=workers,
+                 worker_optimizer="sgd", learning_rate=0.05, metrics=(),
+                 batch_size=batch, communication_window=window)
+    ds = synthetic_mnist(n=n)
+    shards = host_async.stage_worker_shards(
+        ds.repartition(workers), "features", "label", batch, window)
+    params = model.init(jax.random.key(0), jnp.zeros((batch, 784)),
+                        train=False)["params"]
+    runner = host_async.HostAsyncRunner(
+        model, "categorical_crossentropy", t.tx, t.strategy, window=window)
+    ps = host_async.server_for(
+        t.strategy, jax.device_put(params, runner.devices[0]))
+    token = secrets.token_hex(16)
+    service = remote_ps.ParameterServerService(ps, params, token=token)
+    service.start()
+
+    done = threading.Event()
+    errors: list = []
+
+    def train():
+        try:
+            runner.run(params, [shards] * epochs, ps=ps)
+        except Exception as e:
+            errors.append(e)
+        finally:
+            done.set()
+
+    trainer_thread = threading.Thread(target=train, daemon=True)
+    polls: list = []
+    try:
+        with HealthClient(f"127.0.0.1:{service.port}",
+                          token=token) as client:
+            trainer_thread.start()
+            while not done.wait(timeout=interval):
+                status = client.status()
+                polls.append(status)
+                print(_watch_line(status), flush=True)
+            trainer_thread.join()
+            snapshot = client.metrics_snapshot()
+    finally:
+        service.stop()
+    if errors:
+        raise errors[0]
+    return {"polls": polls, "snapshot": snapshot}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="poll the live health endpoints of a real loopback "
+                    "host-async training run")
+    ap.add_argument("--n", type=int, default=2048, help="dataset rows")
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--window", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--interval", type=float, default=0.2,
+                    help="seconds between polls")
+    ap.add_argument("--prom", action="store_true",
+                    help="also print the final snapshot in Prometheus "
+                         "text format")
+    args = ap.parse_args(argv)
+    t0 = time.perf_counter()
+    out = run_probe(n=args.n, workers=args.workers, window=args.window,
+                    batch=args.batch, epochs=args.epochs,
+                    interval=args.interval)
+    snap = out["snapshot"]
+    heartbeats = sorted(k for k in snap["gauges"]
+                        if k.startswith("health.worker.heartbeat_time"))
+    print(f"\n# probe done in {time.perf_counter() - t0:.1f}s: "
+          f"{len(out['polls'])} polls, {len(heartbeats)} workers seen")
+    for key in heartbeats:
+        print(f"  {key}")
+    stal = snap["histograms"].get("ps.commit.staleness")
+    if stal:
+        print(f"  ps.commit.staleness: count={stal['count']} "
+              f"p50={stal['p50']} p95={stal['p95']}")
+    if args.prom:
+        from distkeras_tpu.health.export import snapshot_to_prometheus
+
+        sys.stdout.write("\n" + snapshot_to_prometheus(snap))
+
+
+if __name__ == "__main__":
+    main()

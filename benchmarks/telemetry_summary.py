@@ -10,6 +10,12 @@ round cites.
 
 Usage:
   python benchmarks/telemetry_summary.py <run.telemetry.jsonl> [--top N]
+  python benchmarks/telemetry_summary.py <run.telemetry.jsonl> --format prom
+
+``--format prom`` renders the artifact in the Prometheus text exposition
+format instead of the human tables (same exporter as the live
+``health.cli metrics --format prom`` path), so a post-run artifact can be
+pushed through a Pushgateway or diffed against a live scrape.
 
 No third-party deps: the artifact is plain JSON lines (schema in
 distkeras_tpu/telemetry.py and DESIGN.md §5b).
@@ -122,6 +128,9 @@ def main(argv=None):
                     "Trainer(telemetry_path=...) / dump_telemetry()")
     ap.add_argument("--top", type=int, default=20,
                     help="span rows to show (default 20)")
+    ap.add_argument("--format", choices=("text", "prom"), default="text",
+                    help="'text' = human tables (default); 'prom' = "
+                         "Prometheus text exposition (health/export.py)")
     args = ap.parse_args(argv)
     try:
         rows = load_rows(args.path)
@@ -130,7 +139,12 @@ def main(argv=None):
     if not rows:
         sys.exit(f"{args.path}: empty artifact")
     try:
-        print(summarize(rows, top=args.top))
+        if args.format == "prom":
+            from distkeras_tpu.health.export import rows_to_prometheus
+
+            sys.stdout.write(rows_to_prometheus(rows))
+        else:
+            print(summarize(rows, top=args.top))
     except BrokenPipeError:  # e.g. `... | head`: exit quietly
         os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
 

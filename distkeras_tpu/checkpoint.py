@@ -10,6 +10,7 @@ multi-host-aware) and training resumes from the last step.
 
 from __future__ import annotations
 
+import concurrent.futures
 import os
 from typing import Any, Optional
 
@@ -57,9 +58,23 @@ class Checkpointer:
             # restored yet (elastic-resume topology probe)
             item_handlers=ocp.StandardCheckpointHandler(),
         )
+        # Orbax's CheckpointManager is NOT thread-safe: only the thread
+        # that dispatched a save may reset its finalize bookkeeping, so
+        # saves from two threads (the host_async cadence saver vs the
+        # health watchdog's crash-time snapshot) trip its
+        # ``assert self._finalize_thread is None`` even when externally
+        # serialized with a lock. Route every mutating call through ONE
+        # dedicated dispatch thread instead.
+        self._exec = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ckpt-dispatch")
 
     def save(self, step: int, state: Any, wait: bool = False) -> None:
-        self._mgr.save(int(step), args=ocp.args.StandardSave(state))
+        def _dispatch():
+            # previous async save's finalize must drain before a new save
+            self._mgr.wait_until_finished()
+            self._mgr.save(int(step), args=ocp.args.StandardSave(state))
+
+        self._exec.submit(_dispatch).result()
         if wait:
             self._mgr.wait_until_finished()
 
@@ -103,17 +118,23 @@ class Checkpointer:
         ``save(step)`` when that step already exists, so a fresh run pointed
         at a previous run's directory must clear it or its saves are no-ops
         and a later resume would restore the stale run's state."""
-        self._mgr.wait_until_finished()
-        for step in self.all_steps():
-            self._mgr.delete(int(step))
+        def _clear():
+            self._mgr.wait_until_finished()
+            for step in self.all_steps():
+                self._mgr.delete(int(step))
+
+        self._exec.submit(_clear).result()
 
     def all_steps(self):
         return sorted(self._mgr.all_steps())
 
     def wait(self) -> None:
-        self._mgr.wait_until_finished()
+        # run on the dispatch thread: Orbax only resets its finalize
+        # bookkeeping when the waiter IS the thread that saved
+        self._exec.submit(self._mgr.wait_until_finished).result()
 
     def close(self) -> None:
+        self._exec.shutdown(wait=True)
         self._mgr.close()
 
 

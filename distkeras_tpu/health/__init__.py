@@ -1,0 +1,100 @@
+"""Live health plane: introspection endpoints, heartbeats, watchdog.
+
+Turns the passive telemetry layer (``telemetry.py``: write metrics, dump
+JSONL post-run) into something you can *query while training runs* — see
+DESIGN.md §9. Three pieces:
+
+- :mod:`.endpoints` — ``status`` / ``metrics-snapshot`` / ``recent-spans``
+  ops mounted on the parameter-server control connection and the serving
+  front-end, plus the :class:`HealthClient` poller and the
+  ``python -m distkeras_tpu.health.cli`` command.
+- :mod:`.heartbeat` — per-window worker heartbeats and the rolling-median
+  :class:`StragglerDetector` (default-on inside ``HostAsyncRunner``).
+- :mod:`.watchdog` — :class:`TrainingWatchdog` NaN/divergence/stall
+  monitor with ``warn`` / ``raise`` / ``checkpoint_and_raise`` policies,
+  opt-in through ``DistributedTrainer(health=...)``.
+
+No module in this package imports jax — same rule as ``telemetry.py``,
+enforced by tests: publishing a heartbeat or observing a loss can never
+put a device sync on the step path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Union
+
+from distkeras_tpu.health.endpoints import (HEALTH_OPS, HealthClient,
+                                            handle_health_op)
+from distkeras_tpu.health.heartbeat import (HeartbeatPublisher,
+                                            StragglerDetector)
+from distkeras_tpu.health.watchdog import (POLICIES, Divergence, NaNLoss,
+                                           Stall, TrainingWatchdog,
+                                           WatchdogError)
+
+__all__ = [
+    "HealthConfig", "resolve",
+    "HEALTH_OPS", "HealthClient", "handle_health_op",
+    "HeartbeatPublisher", "StragglerDetector",
+    "POLICIES", "TrainingWatchdog", "WatchdogError",
+    "NaNLoss", "Divergence", "Stall",
+]
+
+
+@dataclasses.dataclass
+class HealthConfig:
+    """Declarative form of the watchdog + straggler knobs, accepted by
+    ``DistributedTrainer(health=...)``. Field semantics match
+    :class:`TrainingWatchdog` / :class:`StragglerDetector`."""
+
+    policy: str = "warn"
+    nan: bool = True
+    divergence_factor: Optional[float] = None
+    stall_timeout_s: Optional[float] = None
+    straggler_k: float = 3.0
+    straggler_min_samples: int = 4
+
+    def make_watchdog(self, checkpoint_fn=None,
+                      on_trip=None) -> TrainingWatchdog:
+        return TrainingWatchdog(
+            policy=self.policy, nan=self.nan,
+            divergence_factor=self.divergence_factor,
+            stall_timeout_s=self.stall_timeout_s,
+            checkpoint_fn=checkpoint_fn, on_trip=on_trip)
+
+    def make_straggler_detector(self) -> StragglerDetector:
+        return StragglerDetector(k=self.straggler_k,
+                                 min_samples=self.straggler_min_samples)
+
+
+def resolve(health: Union[None, str, dict, HealthConfig,
+                          TrainingWatchdog]) -> Optional[HealthConfig]:
+    """Normalize the trainer's ``health=`` argument to a
+    :class:`HealthConfig` (or None = health monitoring off):
+
+    - ``None`` → None
+    - policy string (``"warn"`` / ``"raise"`` / ``"checkpoint_and_raise"``)
+      → config with that policy and defaults otherwise
+    - dict → ``HealthConfig(**dict)``
+    - :class:`HealthConfig` → itself
+
+    A pre-built :class:`TrainingWatchdog` is rejected: the trainer creates
+    a fresh watchdog per ``train()`` call (trip state must not leak across
+    runs) and binds ``checkpoint_fn`` itself.
+    """
+    if health is None or isinstance(health, HealthConfig):
+        return health
+    if isinstance(health, str):
+        if health not in POLICIES:
+            raise ValueError(f"health policy must be one of {POLICIES}, "
+                             f"got {health!r}")
+        return HealthConfig(policy=health)
+    if isinstance(health, dict):
+        return HealthConfig(**health)
+    if isinstance(health, TrainingWatchdog):
+        raise TypeError(
+            "pass a HealthConfig (or dict/policy string), not a built "
+            "TrainingWatchdog — the trainer makes a fresh watchdog per "
+            "train() so trip state cannot leak across runs")
+    raise TypeError(f"health= must be None, a policy string, a dict, or a "
+                    f"HealthConfig; got {type(health).__name__}")

@@ -1,0 +1,97 @@
+"""Poller CLI for the live introspection endpoints.
+
+Point it at a running parameter-server service or serving front-end::
+
+    python -m distkeras_tpu.health.cli 127.0.0.1:41217 status
+    python -m distkeras_tpu.health.cli 127.0.0.1:41217 metrics --format prom
+    python -m distkeras_tpu.health.cli 127.0.0.1:41217 spans --chrome t.json
+    python -m distkeras_tpu.health.cli 127.0.0.1:41217 watch --interval 2
+
+Commands: ``status`` (one liveness digest), ``metrics`` (full snapshot as
+JSON or Prometheus text), ``spans`` (recent span events; ``--chrome PATH``
+writes a chrome://tracing file instead), ``watch`` (poll ``status``
+forever — or ``--count N`` times — printing one compact line per poll).
+Pass ``--token`` when the service was started with a shared secret.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Optional
+
+from distkeras_tpu.health import export
+from distkeras_tpu.health.endpoints import HealthClient
+
+
+def _watch_line(status: dict) -> str:
+    workers = status.get("workers", {})
+    ages = [d.get("age_s") for d in workers.values()
+            if d.get("age_s") is not None]
+    parts = [
+        time.strftime("%H:%M:%S"),
+        f"workers={len(workers)}",
+        f"max_hb_age={max(ages):.1f}s" if ages else "max_hb_age=-",
+        f"stragglers={','.join(status.get('stragglers', [])) or '-'}",
+        f"watchdog={'TRIPPED' if status.get('watchdog_tripped') else 'ok'}",
+    ]
+    for key in ("clock", "queue_depth"):
+        if key in status:
+            parts.append(f"{key}={status[key]}")
+    return "  ".join(parts)
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m distkeras_tpu.health.cli",
+        description="Query the live health endpoints of a running "
+                    "parameter-server or serving service.")
+    ap.add_argument("address", help="host:port of the service")
+    ap.add_argument("command", choices=("status", "metrics", "spans",
+                                        "watch"))
+    ap.add_argument("--token", default=None,
+                    help="shared auth token of the service")
+    ap.add_argument("--format", choices=("json", "prom"), default="json",
+                    help="metrics output format (default json)")
+    ap.add_argument("--limit", type=int, default=100,
+                    help="span events to fetch (spans command)")
+    ap.add_argument("--chrome", metavar="PATH", default=None,
+                    help="write spans as a Chrome trace file instead of "
+                         "printing JSON")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between polls (watch command)")
+    ap.add_argument("--count", type=int, default=0,
+                    help="stop watch after N polls (0 = forever)")
+    args = ap.parse_args(argv)
+
+    with HealthClient(args.address, token=args.token) as client:
+        if args.command == "status":
+            print(json.dumps(client.status(), indent=2, sort_keys=True))
+        elif args.command == "metrics":
+            snap = client.metrics_snapshot()
+            if args.format == "prom":
+                sys.stdout.write(export.snapshot_to_prometheus(snap))
+            else:
+                print(json.dumps(snap, indent=2, sort_keys=True))
+        elif args.command == "spans":
+            spans = client.recent_spans(limit=args.limit)
+            if args.chrome:
+                export.write_chrome_trace(args.chrome, spans)
+                print(f"wrote {len(spans)} span events to {args.chrome}")
+            else:
+                print(json.dumps(spans, indent=2))
+        else:  # watch
+            n = 0
+            while True:
+                print(_watch_line(client.status()), flush=True)
+                n += 1
+                if args.count and n >= args.count:
+                    break
+                time.sleep(args.interval)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,169 @@
+"""Live introspection endpoints: handler + poller client.
+
+The health plane does not invent a second server. The parameter-server
+control connection (``parallel/remote_ps.py``) and the serving front-end
+(``serving/server.py``) already speak the same length-prefixed framing
+(``[u32 header_len][JSON header][blobs...]``) behind the same shared-token
+auth — so the introspection ops mount as three extra header-only ops on
+BOTH services:
+
+===================  ======================================================
+op                   reply header
+===================  ======================================================
+``status``           compact liveness digest: per-worker heartbeat ages,
+                     staleness, stragglers, watchdog state, plus
+                     service-specific fields the host merges in
+                     (PS clock / serving queue depth)
+``metrics-snapshot`` ``{"snapshot": MetricsRegistry.snapshot()}`` — the
+                     full lock-consistent registry view
+``recent-spans``     ``{"spans": [...]}`` — newest ``limit`` span events
+===================  ======================================================
+
+Everything rides in JSON headers (no blobs), so :class:`HealthClient` and
+the ``python -m distkeras_tpu.health.cli`` poller work against either
+service with one code path.
+
+This module stays import-light: the framing helpers are imported lazily
+inside :class:`HealthClient` so ``remote_ps`` (which imports this module to
+mount the ops) never forms an import cycle, and nothing here imports jax.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Any, Dict, List, Optional
+
+from distkeras_tpu import telemetry
+
+HEALTH_OPS = ("status", "metrics-snapshot", "recent-spans")
+
+#: A worker whose last heartbeat is older than this (seconds) is reported
+#: ``"late"`` in the status digest even if the straggler detector (which
+#: only sees *completed* windows) has not flagged it.
+LATE_HEARTBEAT_S = 30.0
+
+
+def _worker_digest(snapshot: dict, now: float) -> Dict[str, dict]:
+    """Group the ``health.worker.*`` gauges by worker id into one dict per
+    worker: ``{"age_s": ..., "clock": ..., "staleness": ..., "window_s":
+    ..., "windows": ..., "straggler": bool, "late": bool}``."""
+    from distkeras_tpu.health.export import _parse_key
+
+    workers: Dict[str, dict] = {}
+
+    def bucket(key: str) -> Optional[tuple]:
+        name, labels = _parse_key(key)
+        if not name.startswith("health.worker.") or "worker" not in labels:
+            return None
+        return labels["worker"], name[len("health.worker."):]
+
+    for key, value in snapshot.get("gauges", {}).items():
+        hit = bucket(key)
+        if hit is None:
+            continue
+        worker, field = hit
+        w = workers.setdefault(worker, {})
+        if field == "heartbeat_time":
+            w["age_s"] = round(now - value, 3)
+        elif field == "straggler":
+            w["straggler"] = bool(value)
+        else:
+            w[field] = value
+    for key, value in snapshot.get("counters", {}).items():
+        hit = bucket(key)
+        if hit is not None and hit[1] == "windows":
+            workers.setdefault(hit[0], {})["windows"] = value
+    for w in workers.values():
+        w["late"] = w.get("age_s", 0.0) > LATE_HEARTBEAT_S
+    return workers
+
+
+def handle_health_op(op: str, header: dict,
+                     extra_status: Optional[dict] = None) -> dict:
+    """Compute the reply header for one introspection op. The hosting
+    service passes ``extra_status`` (its own identity + live fields) which
+    is merged into the ``status`` digest."""
+    reg = telemetry.get_registry()
+    if reg is None:
+        return {"error": "telemetry is uninstalled in this process"}
+    if op == "metrics-snapshot":
+        return {"snapshot": reg.snapshot()}
+    if op == "recent-spans":
+        return {"spans": reg.recent_spans(int(header.get("limit", 100)))}
+    if op == "status":
+        now = time.time()
+        snap = reg.snapshot()
+        workers = _worker_digest(snap, now)
+        gauges = snap.get("gauges", {})
+        status = {
+            "time": now,
+            "workers": workers,
+            "stragglers": sorted(w for w, d in workers.items()
+                                 if d.get("straggler")),
+            "watchdog_tripped": bool(
+                gauges.get("health.watchdog.tripped", 0.0)),
+            "counters": {k: v for k, v in
+                         snap.get("counters", {}).items()
+                         if not k.startswith("health.worker.")},
+        }
+        if extra_status:
+            status.update(extra_status)
+        return status
+    return {"error": f"unknown health op {op!r}"}
+
+
+class HealthClient:
+    """Poller for the introspection ops of either service (PS or serving).
+
+    One persistent control connection, header-only requests; ``token``
+    must match the service's shared secret. The wire helpers are imported
+    lazily so importing this module never pulls in ``remote_ps`` (which
+    itself imports this module to mount the ops)."""
+
+    def __init__(self, address: str, token: Optional[str] = None,
+                 timeout: float = 10.0):
+        from distkeras_tpu.parallel.remote_ps import (recv_message,
+                                                      send_message)
+
+        self._send, self._recv = send_message, recv_message
+        host, _, port = address.rpartition(":")
+        self.address = address
+        self.token = token
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def _call(self, op: str, **fields) -> dict:
+        header: Dict[str, Any] = {"op": op, **fields}
+        if self.token is not None:
+            header["token"] = self.token
+        self._send(self._sock, header)
+        reply, _ = self._recv(self._sock)
+        if "error" in reply:
+            raise RuntimeError(
+                f"health op {op!r} against {self.address}: "
+                f"{reply['error']}")
+        reply.pop("blob_lens", None)
+        return reply
+
+    def status(self) -> dict:
+        return self._call("status")
+
+    def metrics_snapshot(self) -> dict:
+        return self._call("metrics-snapshot")["snapshot"]
+
+    def recent_spans(self, limit: int = 100) -> List[dict]:
+        return self._call("recent-spans", limit=int(limit))["spans"]
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "HealthClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

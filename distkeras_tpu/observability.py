@@ -208,6 +208,11 @@ def calibrate_peak(size: int = 16384, chain: int = 64, repeats: int = 3,
         times.append(time.perf_counter() - t0)
     dt = sorted(times)[len(times) // 2]
     achieved = flops / dt
+    # published as gauges so the live health plane (metrics-snapshot /
+    # Prometheus export) carries the calibration alongside the run
+    telemetry.gauge("observability.achieved_flops").set(achieved)
+    telemetry.gauge("observability.peak_flops").set(peak)
+    telemetry.gauge("observability.calibration_ratio").set(achieved / peak)
     return {"achieved": achieved, "peak": peak, "ratio": achieved / peak}
 
 
@@ -217,7 +222,12 @@ def mfu(flops_per_step: float, step_time_s: float, num_chips: int = 1,
     peak = peak_per_chip if peak_per_chip is not None else device_peak_flops()
     if peak is None or not flops_per_step or step_time_s <= 0:
         return None
-    return flops_per_step / (step_time_s * peak * num_chips)
+    value = flops_per_step / (step_time_s * peak * num_chips)
+    # mirror into the telemetry registry: MFU becomes queryable through the
+    # live metrics-snapshot endpoint and lands in the Prometheus export
+    telemetry.gauge("observability.mfu").set(value)
+    telemetry.gauge("observability.flops_per_step").set(flops_per_step)
+    return value
 
 
 class StepTimer:

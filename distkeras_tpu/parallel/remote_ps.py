@@ -45,6 +45,7 @@ import jax
 import numpy as np
 
 from distkeras_tpu import comms, telemetry
+from distkeras_tpu.health.endpoints import HEALTH_OPS, handle_health_op
 from distkeras_tpu.parameter_servers import ParameterServer
 from distkeras_tpu.utils.fetch import device_get_batched
 
@@ -201,11 +202,13 @@ class ParameterServerService:
         self._sock.listen(64)
         self.port = self._sock.getsockname()[1]
         self._running = False
+        self._t_start = time.time()
         self._threads: list = []
 
     # -- lifecycle (reference vocabulary) ---------------------------------
     def start(self) -> None:
         self._running = True
+        self._t_start = time.time()
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
         self._threads.append(t)
@@ -323,6 +326,19 @@ class ParameterServerService:
             center, clock = self.ps.pull()
             self._reply(conn, op, {"windows": merged, "clock": clock},
                         codec.encode(center, kind="pull"))
+        elif op in HEALTH_OPS:
+            # live health plane (DESIGN.md §9): header-only introspection
+            # sharing this connection's framing + token auth
+            with self._hist_cv:
+                uploaded = len(self._histories)
+            self._reply(conn, op, handle_health_op(op, header, extra_status={
+                "service": "parameter_server",
+                "clock": int(self.ps.num_updates),  # no center fetch
+                "expected_processes": self.expected,
+                "histories_uploaded": uploaded,
+                "uptime_s": round(time.time() - self._t_start, 3),
+                "port": self.port,
+            }))
         else:
             _sendall(conn, {"error": f"unknown op {op!r}"})
 

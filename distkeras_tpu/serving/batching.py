@@ -90,6 +90,15 @@ class RequestQueue:
     def __len__(self) -> int:
         return len(self._dq)
 
+    def oldest_age(self, now: Optional[float] = None) -> Optional[float]:
+        """Age (seconds) of the oldest queued request, or None when empty —
+        the health plane's head-of-line latency signal."""
+        with self._cv:
+            if not self._dq:
+                return None
+            return (time.monotonic() if now is None else now) \
+                - self._dq[0].t_submit
+
     def put(self, req: Request) -> None:
         self.put_many((req,))
 

@@ -267,6 +267,25 @@ class ServingEngine:
                 self._latency_h.record(now - req.t_submit)
         self._completed.inc(n)
 
+    # -- health -----------------------------------------------------------
+    def health_status(self) -> dict:
+        """Live queue state for the health plane: depth, head-of-line age,
+        compile-cache contents. Also refreshes the ``serving.queue_depth``
+        and ``serving.oldest_request_age_s`` gauges so a metrics snapshot
+        taken between submits reflects the queue as of this call."""
+        depth = len(self._queue)
+        age = self._queue.oldest_age()
+        telemetry.gauge("serving.queue_depth").set(depth)
+        telemetry.gauge("serving.oldest_request_age_s").set(
+            0.0 if age is None else age)
+        return {
+            "queue_depth": depth,
+            "oldest_request_age_s": age,
+            "queue_capacity": self._queue.capacity,
+            "compiled_buckets": list(self.compiled_buckets),
+            "shut": self._shut,
+        }
+
     # -- lifecycle --------------------------------------------------------
     def shutdown(self, drain: bool = True, timeout: float = 60.0) -> None:
         """Stop the engine. ``drain=True`` serves everything already

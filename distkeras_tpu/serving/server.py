@@ -16,6 +16,11 @@ Protocol (header JSON + raw blobs, see remote_ps):
     {"op": "stats", "token": ...} -> {"counters": {...}, "gauges": {...}}
     {"op": "ping", "token": ...}  -> {"ok": true}
 
+plus the three live-health introspection ops (``status`` /
+``metrics-snapshot`` / ``recent-spans``, see ``health/endpoints.py``) —
+the serving ``status`` digest includes the engine's queue depth and
+oldest-request age.
+
 A request's rows ride the engine's ``submit_many`` (atomic admission:
 either every row is queued or the whole request is rejected with
 ``queue_full``), so one TCP client cannot partially starve another.
@@ -30,6 +35,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from distkeras_tpu import telemetry
+from distkeras_tpu.health.endpoints import HEALTH_OPS, handle_health_op
 from distkeras_tpu.parallel.remote_ps import (
     check_token,
     recv_message,
@@ -139,6 +145,14 @@ class ServingServer:
             send_message(conn, self._stats())
         elif op == "ping":
             send_message(conn, {"ok": True})
+        elif op in HEALTH_OPS:
+            # live health plane (DESIGN.md §9): same three introspection
+            # ops the parameter-server control connection mounts
+            send_message(conn, handle_health_op(op, header, extra_status={
+                "service": "serving",
+                "port": self.port,
+                **self.engine.health_status(),
+            }))
         else:
             send_message(conn, {"error": f"unknown op {op!r}",
                                 "kind": "bad_request"})

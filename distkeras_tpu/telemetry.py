@@ -302,12 +302,33 @@ class MetricsRegistry:
             yield {"kind": "span", "name": name, "labels": labels,
                    "t0": t0, "dur_s": dur}
 
+    def recent_spans(self, limit: int = 100) -> List[dict]:
+        """The newest ``limit`` span events as row dicts (oldest first) —
+        the live ``recent-spans`` introspection endpoint's payload."""
+        events = list(self.spans)[-max(0, int(limit)):]
+        return [{"kind": "span", "name": name, "labels": labels,
+                 "t0": t0, "dur_s": dur} for name, t0, dur, labels in events]
+
     def snapshot(self) -> dict:
-        """Structured view for ``Trainer.get_telemetry()``: metric rows
-        grouped by kind, keyed by ``name{label=...}``."""
+        """Structured view for ``Trainer.get_telemetry()`` and the live
+        ``metrics-snapshot`` endpoint: metric rows grouped by kind, keyed by
+        ``name{label=...}``.
+
+        Lock-consistent: the metric SET and the span timeline are copied
+        under the creation lock, so a snapshot taken from an introspection
+        handler thread never sees a half-registered metric or tears the
+        span deque against a concurrent ``clear()``. Individual values are
+        still read without stopping writers (a read may miss an in-flight
+        bump — monotonic, never garbage)."""
+        with self._create_lock:
+            metrics = list(self._metrics.values())
+            spans = list(self.spans)
         out: dict = {"counters": {}, "gauges": {}, "histograms": {},
                      "spans": []}
-        for row in self.rows():
+        rows = [m.row() for m in metrics] + [
+            {"kind": "span", "name": name, "labels": labels,
+             "t0": t0, "dur_s": dur} for name, t0, dur, labels in spans]
+        for row in rows:
             kind = row["kind"]
             if kind == "span":
                 out["spans"].append(row)
@@ -340,13 +361,29 @@ class MetricsRegistry:
 
 def load_jsonl(path: str) -> List[dict]:
     """Load a dumped artifact back into a list of row dicts (meta line
-    included as row 0)."""
-    rows = []
+    included as row 0).
+
+    A truncated TRAILING line — the shape a crash-time dump leaves when the
+    process dies mid-write — is tolerated: the parsed prefix is returned
+    and a warning is emitted. Corruption anywhere *before* the last line
+    still raises (that artifact is damaged, not merely cut short)."""
     with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                rows.append(json.loads(line))
+        lines = [ln for ln in (raw.strip() for raw in f) if ln]
+    rows = []
+    for i, line in enumerate(lines):
+        try:
+            rows.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                import warnings
+
+                warnings.warn(
+                    f"{path}: dropping truncated trailing line "
+                    f"({line[:60]!r}...); returning the "
+                    f"{len(rows)}-row parsed prefix (crash-time dump)",
+                    RuntimeWarning, stacklevel=2)
+                break
+            raise
     return rows
 
 

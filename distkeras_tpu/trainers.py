@@ -286,6 +286,7 @@ class DistributedTrainer(Trainer):
                  telemetry_path: Optional[str] = None,
                  codec: str = "raw",
                  comms_overlap: bool = False,
+                 health=None,
                  **strategy_kwargs):
         super().__init__(model, loss, worker_optimizer, learning_rate,
                          metrics, features_col, label_col, batch_size,
@@ -381,6 +382,15 @@ class DistributedTrainer(Trainer):
                 "exchange; sync mode folds commits in-graph (no wire)")
         self.codec = codec
         self.comms_overlap = bool(comms_overlap)
+        # health monitoring (DESIGN.md §9): None | policy string | dict |
+        # HealthConfig — normalized here so a bad policy fails at
+        # construction. A fresh TrainingWatchdog is built per train() call
+        # (trip state must not leak across runs). host_async runs get the
+        # full live plane (stall monitor, crash-time checkpoint_fn); sync
+        # mode observes the loss stream post-epoch.
+        from distkeras_tpu import health as health_lib
+
+        self.health = health_lib.resolve(health)
         self.num_updates = 0
         self.staleness_history: list[float] = []
 
@@ -403,10 +413,15 @@ class DistributedTrainer(Trainer):
         self.staleness_history.extend(
             float(s) for s in stal.mean(axis=0).reshape(-1))
         w, r, win = ms["loss"].shape
-        for ri in range(r):
-            for si in range(win):
-                self.history.append(
-                    {k: float(v[:, ri, si].mean()) for k, v in ms.items()})
+        wd = getattr(self, "_watchdog", None)  # sync-path health checks:
+        for ri in range(r):                    # post-epoch, on the worker-
+            for si in range(win):              # mean loss stream
+                step = {k: float(v[:, ri, si].mean()) for k, v in ms.items()}
+                self.history.append(step)
+                if wd is not None:
+                    wd.observe_loss(step["loss"])
+        if wd is not None:
+            wd.notify_progress()
         if self.strategy.exchanges:  # PS commit clock: only real commits count
             self.num_updates += rounds * self.num_workers
 
@@ -608,6 +623,12 @@ class DistributedTrainer(Trainer):
         epoch_fn = self._epoch_fn
         self.history = []
         self.staleness_history = []
+        # fresh watchdog per train() (no trip-state leak across runs); in
+        # sync mode it sees post-epoch means only, so checkpoint_and_raise
+        # degrades to raise (the epoch-boundary save just above the trip is
+        # the recovery point) — the live plane is mode='host_async'
+        self._watchdog = self.health.make_watchdog() \
+            if self.health is not None else None
         round_offset = int(counters[0])
         self.num_updates = int(counters[1])
         staged = None  # shuffle=False + whole-epoch staging: stage once
@@ -824,6 +845,12 @@ class DistributedTrainer(Trainer):
                     devices=self.devices or jax.local_devices(),
                     codec=self.codec, overlap=self.comms_overlap)
         runner = self._async_runner
+        watchdog = None
+        if self.health is not None:
+            # fresh per train(): trip state must not leak across runs; the
+            # runner binds checkpoint_fn (live-center snapshot) + on_trip
+            watchdog = self.health.make_watchdog()
+            runner.straggler = self.health.make_straggler_detector()
         folds = (self.checkpoint_folds or self.num_workers) \
             if ckpt is not None else 0
         try:
@@ -833,11 +860,13 @@ class DistributedTrainer(Trainer):
                         host_async.run_cross_process(
                             runner, init_params, epoch_shards,
                             worker_offset=worker_offset, checkpointer=ckpt,
-                            checkpoint_folds=folds, start_clock=start_clock)
+                            checkpoint_folds=folds, start_clock=start_clock,
+                            watchdog=watchdog)
                 else:
                     params, history, staleness, num_updates = runner.run(
                         init_params, epoch_shards, checkpointer=ckpt,
-                        checkpoint_folds=folds, start_clock=start_clock)
+                        checkpoint_folds=folds, start_clock=start_clock,
+                        watchdog=watchdog)
         except BaseException:
             if ckpt is not None:  # crash path: finalize in-flight snapshots
                 try:              # so resume sees the last completed one

@@ -1,4 +1,4 @@
-"""Fault tolerance: restart-from-checkpoint retry loop.
+"""Fault tolerance: restart-from-checkpoint retry loop + fault injection.
 
 Reference parity (SURVEY.md §5): dist-keras had NO failure handling of its
 own — Spark retried failed tasks and the parameter server was an unpersisted
@@ -6,15 +6,87 @@ single point of failure. The TPU-native story makes the checkpoint the
 recovery primitive: the trainer snapshots per epoch (``checkpoint_dir=``),
 and this runner resumes it across crashes — the moral equivalent of
 "Spark-grade retry".
+
+This module also owns the **fault-injection hooks** the health plane's
+watchdog tests exercise (DESIGN.md §9): instrumented sites pass observed
+values through :func:`apply`, and a test (or chaos run) arms a corruption
+with :func:`inject` — e.g. ``inject("host_async.window_loss", after=3)``
+makes the fourth observed window loss a NaN, which the training watchdog
+must catch. Hooks are empty-dict cheap when nothing is armed.
 """
 
 from __future__ import annotations
 
 import logging
+import math
+import threading
 import time
 from typing import Optional
 
 logger = logging.getLogger("distkeras_tpu.fault")
+
+
+# -- fault injection (health/watchdog test surface) --------------------------
+
+class _Injection:
+    __slots__ = ("value", "after", "count", "skipped", "fired")
+
+    def __init__(self, value: float, after: int, count: Optional[int]):
+        self.value = value
+        self.after = int(after)    # clean observations before firing
+        self.count = count         # firings before disarming (None = all)
+        self.skipped = 0
+        self.fired = 0
+
+
+_injections: dict = {}
+_inj_lock = threading.Lock()
+
+
+def inject(site: str, value: float = math.nan, after: int = 0,
+           count: Optional[int] = None) -> None:
+    """Arm a fault at ``site``: the first ``after`` values observed by
+    :func:`apply` pass through clean, then the next ``count`` (None = every
+    subsequent one) are replaced by ``value`` (default NaN). Sites in use:
+
+    - ``"host_async.window_loss"`` — each async worker's per-window mean
+      loss, observed in the worker's bookkeeping (feeds the watchdog).
+    """
+    with _inj_lock:
+        _injections[site] = _Injection(float(value), after, count)
+
+
+def clear_injections(site: Optional[str] = None) -> None:
+    """Disarm one site, or every site (``site=None``) — test teardown."""
+    with _inj_lock:
+        if site is None:
+            _injections.clear()
+        else:
+            _injections.pop(site, None)
+
+
+def apply(site: str, value: float) -> float:
+    """Pass an observed value through the injection hook for ``site``.
+    Returns the (possibly corrupted) value; identity when nothing is armed.
+    Thread-safe: concurrent observers consume ``after``/``count`` budgets
+    exactly once each."""
+    inj = _injections.get(site)
+    if inj is None:
+        return value
+    with _inj_lock:
+        inj = _injections.get(site)
+        if inj is None:
+            return value
+        if inj.skipped < inj.after:
+            inj.skipped += 1
+            return value
+        if inj.count is not None and inj.fired >= inj.count:
+            return value
+        inj.fired += 1
+    from distkeras_tpu import telemetry
+
+    telemetry.counter("fault.injected", site=site).inc()
+    return inj.value
 
 
 def run_with_retries(trainer, dataset, shuffle: bool = False,

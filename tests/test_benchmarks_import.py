@@ -20,12 +20,14 @@ PKG_MODULES = sorted(
 
 def test_discovery_found_the_tools():
     # the floor protects against the glob silently matching nothing
-    assert len(SCRIPTS) >= 7, SCRIPTS
+    assert len(SCRIPTS) >= 8, SCRIPTS
     assert "distkeras_tpu.benchmarks.run_config" in PKG_MODULES
     # the serving load generator (ISSUE 2) must be under the smoke glob
     assert any(os.path.basename(p) == "serving_load.py" for p in SCRIPTS)
     # the comms benchmark (ISSUE 3) too
     assert any(os.path.basename(p) == "comms_bench.py" for p in SCRIPTS)
+    # the live health-plane probe (ISSUE 4) too
+    assert any(os.path.basename(p) == "health_probe.py" for p in SCRIPTS)
 
 
 @pytest.mark.parametrize("path", SCRIPTS,

@@ -1,0 +1,594 @@
+"""Health plane tests: watchdog policies, heartbeats/stragglers, live
+introspection endpoints, and the exporters (DESIGN.md §9).
+
+The integration tests run the REAL loopback stack: a HostAsyncRunner job
+behind a ParameterServerService polled mid-run by a HealthClient, and a
+NaN fault injected through utils/fault.py tripping checkpoint_and_raise.
+"""
+
+import inspect
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.health import (
+    HealthConfig,
+    HealthClient,
+    HeartbeatPublisher,
+    StragglerDetector,
+    TrainingWatchdog,
+    resolve,
+)
+from distkeras_tpu.health import cli as health_cli
+from distkeras_tpu.health import endpoints, export, heartbeat, watchdog
+from distkeras_tpu.health.watchdog import (
+    Divergence,
+    NaNLoss,
+    Stall,
+    WatchdogError,
+)
+from distkeras_tpu.utils import fault
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    telemetry.reset()
+    fault.clear_injections()
+    yield
+    fault.clear_injections()
+    telemetry.reset()
+
+
+# -- the no-jax rule ---------------------------------------------------------
+
+def test_health_modules_never_import_jax():
+    """Same contract tests/test_telemetry.py enforces for telemetry.py:
+    the health plane sits on worker step paths; an accidental jax import
+    is how a device sync sneaks in."""
+    import distkeras_tpu.health as health_pkg
+
+    for mod in (health_pkg, endpoints, export, heartbeat, watchdog,
+                health_cli):
+        src = inspect.getsource(mod)
+        assert "import jax" not in src, mod.__name__
+
+
+# -- watchdog: NaN / divergence / stall x policies ---------------------------
+
+def test_watchdog_nan_raise_policy():
+    wd = TrainingWatchdog(policy="raise")
+    wd.observe_loss(1.0)
+    with pytest.raises(NaNLoss, match="non-finite loss"):
+        wd.observe_loss(float("nan"))
+    assert wd.tripped is not None
+    # after the trip every observation is a no-op (no second raise)
+    wd.observe_loss(float("inf"))
+    snap = telemetry.get_registry().snapshot()
+    assert snap["counters"]["health.watchdog.trips"
+                            "{kind=nan,policy=raise}"] == 1
+    assert snap["gauges"]["health.watchdog.tripped"] == 1.0
+
+
+def test_watchdog_nan_via_fault_injection_hook():
+    """The fault hook feeds the watchdog exactly as host_async does."""
+    fault.inject("host_async.window_loss", after=2)
+    wd = TrainingWatchdog(policy="raise")
+    wd.observe_loss(fault.apply("host_async.window_loss", 0.5))
+    wd.observe_loss(fault.apply("host_async.window_loss", 0.4))
+    with pytest.raises(NaNLoss):
+        wd.observe_loss(fault.apply("host_async.window_loss", 0.3))
+
+
+def test_watchdog_inf_update_norm():
+    wd = TrainingWatchdog(policy="raise")
+    wd.observe_update_norm(3.0)
+    with pytest.raises(NaNLoss, match="update norm"):
+        wd.observe_update_norm(float("inf"))
+
+
+def test_watchdog_warn_policy_continues():
+    wd = TrainingWatchdog(policy="warn")
+    with pytest.warns(RuntimeWarning, match="policy=warn"):
+        wd.observe_loss(float("nan"))
+    assert isinstance(wd.tripped, NaNLoss)
+    wd.observe_loss(1.0)  # training goes on; observations are no-ops
+
+
+def test_watchdog_divergence_deterministic():
+    # ema=0 -> smoothed == raw value: 1.0,1.0 set best=1.0, then 5.0 at
+    # n=3 (== min_observations) exceeds 2x best
+    wd = TrainingWatchdog(policy="raise", divergence_factor=2.0,
+                          min_observations=3, ema=0.0)
+    wd.observe_loss(1.0)
+    wd.observe_loss(1.0)
+    with pytest.raises(Divergence, match="exceeded 2.0x"):
+        wd.observe_loss(5.0)
+
+
+def test_watchdog_divergence_respects_min_observations():
+    wd = TrainingWatchdog(policy="raise", divergence_factor=2.0,
+                          min_observations=5, ema=0.0)
+    wd.observe_loss(1.0)
+    wd.observe_loss(5.0)  # n=2 < 5: no trip yet
+    assert wd.tripped is None
+
+
+def test_watchdog_stall_with_synthetic_clock():
+    t = [0.0]
+    wd = TrainingWatchdog(policy="raise", stall_timeout_s=10.0,
+                          clock=lambda: t[0])
+    wd.notify_progress()
+    t[0] = 5.0
+    wd.check_stall()  # idle 5s < 10s
+    t[0] = 16.0
+    with pytest.raises(Stall, match="no training progress"):
+        wd.check_stall()
+    assert telemetry.get_registry().snapshot()["gauges"][
+        "health.watchdog.idle_s"] == 16.0
+
+
+def test_watchdog_progress_resets_stall_clock():
+    t = [0.0]
+    wd = TrainingWatchdog(policy="raise", stall_timeout_s=10.0,
+                          clock=lambda: t[0])
+    wd.notify_progress()
+    t[0] = 9.0
+    wd.notify_progress()
+    t[0] = 18.0
+    wd.check_stall()  # 9s since last progress: fine
+    assert wd.tripped is None
+
+
+def test_watchdog_on_trip_called_for_raise_not_warn():
+    seen = []
+    wd = TrainingWatchdog(policy="raise", on_trip=seen.append)
+    with pytest.raises(NaNLoss):
+        wd.observe_loss(float("nan"))
+    assert len(seen) == 1 and isinstance(seen[0], NaNLoss)
+
+    seen2 = []
+    wd2 = TrainingWatchdog(policy="warn", on_trip=seen2.append)
+    with pytest.warns(RuntimeWarning):
+        wd2.observe_loss(float("nan"))
+    assert seen2 == []  # warn never aborts sibling workers
+
+
+def test_watchdog_checkpoint_and_raise_calls_fn_and_survives_its_failure():
+    calls = []
+    wd = TrainingWatchdog(policy="checkpoint_and_raise",
+                          checkpoint_fn=lambda: calls.append(1))
+    with pytest.raises(NaNLoss):
+        wd.observe_loss(float("nan"))
+    assert calls == [1]
+
+    def boom():
+        raise OSError("disk full")
+
+    wd2 = TrainingWatchdog(policy="checkpoint_and_raise",
+                           checkpoint_fn=boom)
+    with pytest.warns(RuntimeWarning, match="crash-time checkpoint failed"):
+        with pytest.raises(NaNLoss) as ei:
+            wd2.observe_loss(float("nan"))
+    assert isinstance(ei.value.__context__, OSError)
+
+
+def test_watchdog_stall_monitor_thread_delivers_via_on_trip():
+    t = [0.0]
+    seen = []
+    wd = TrainingWatchdog(policy="raise", stall_timeout_s=0.05,
+                          clock=lambda: t[0], on_trip=seen.append)
+    wd.start_stall_monitor(interval=0.01)
+    try:
+        t[0] = 1.0  # way past the timeout; monitor should trip soon
+        deadline = time.time() + 5
+        while not seen and time.time() < deadline:
+            time.sleep(0.01)
+        assert seen and isinstance(seen[0], Stall)
+    finally:
+        wd.stop_stall_monitor()
+
+
+def test_watchdog_rejects_bad_config():
+    with pytest.raises(ValueError, match="policy"):
+        TrainingWatchdog(policy="explode")
+    with pytest.raises(ValueError, match="divergence_factor"):
+        TrainingWatchdog(divergence_factor=0.5)
+    with pytest.raises(ValueError, match="ema"):
+        TrainingWatchdog(ema=1.0)
+
+
+# -- health config resolution ------------------------------------------------
+
+def test_resolve_health_argument_forms():
+    assert resolve(None) is None
+    cfg = HealthConfig(policy="raise")
+    assert resolve(cfg) is cfg
+    assert resolve("checkpoint_and_raise").policy == "checkpoint_and_raise"
+    assert resolve({"policy": "warn", "stall_timeout_s": 5.0}) \
+        .stall_timeout_s == 5.0
+    with pytest.raises(ValueError, match="policy"):
+        resolve("panic")
+    with pytest.raises(TypeError, match="fresh watchdog"):
+        resolve(TrainingWatchdog())
+    with pytest.raises(TypeError, match="health="):
+        resolve(42)
+
+
+# -- heartbeats + straggler detector ----------------------------------------
+
+def test_heartbeat_gauges_and_counter():
+    hb = HeartbeatPublisher(time_fn=lambda: 1000.0)
+    hb.publish(worker=0, clock=5, staleness=2.0, window_s=0.25)
+    hb.publish(worker=0, clock=7, staleness=1.0, window_s=0.30)
+    snap = telemetry.get_registry().snapshot()
+    g = snap["gauges"]
+    assert g["health.worker.heartbeat_time{worker=0}"] == 1000.0
+    assert g["health.worker.clock{worker=0}"] == 7
+    assert g["health.worker.staleness{worker=0}"] == 1.0
+    assert g["health.worker.window_s{worker=0}"] == 0.30
+    assert snap["counters"]["health.worker.windows{worker=0}"] == 2
+
+
+def test_straggler_detector_is_deterministic_on_scripted_durations():
+    det = StragglerDetector(k=3.0, min_samples=4)
+    durations = [1.0, 1.0, 1.0, 1.0, 1.0, 10.0, 1.0, 1.0]
+    verdicts = [det.observe(0, d) for d in durations]
+    # cold start (pool < 4) never flags; the 10s window is > 3x the
+    # median-of-ones; the next 1s window un-flags
+    assert verdicts == [False] * 5 + [True, False, False]
+    snap = telemetry.get_registry().snapshot()
+    assert snap["counters"]["health.straggler.events{worker=0}"] == 1
+    assert snap["gauges"]["health.worker.straggler{worker=0}"] == 0.0
+    assert snap["gauges"]["health.stragglers"] == 0.0
+    assert det.stragglers == []
+
+
+def test_straggler_detector_flags_one_worker_among_peers():
+    det = StragglerDetector(k=3.0, min_samples=4)
+    for _ in range(3):
+        for w in (0, 1):
+            det.observe(w, 0.1)
+    assert det.observe(1, 1.0) is True  # 10x the fleet median
+    assert det.stragglers == [1]
+    assert telemetry.get_registry().snapshot()["gauges"][
+        "health.stragglers"] == 1.0
+
+
+def test_straggler_detector_validates_args():
+    with pytest.raises(ValueError, match="k must be > 1"):
+        StragglerDetector(k=1.0)
+    with pytest.raises(ValueError, match="min_samples"):
+        StragglerDetector(min_samples=0)
+
+
+# -- exporters ---------------------------------------------------------------
+
+def test_prometheus_export_from_snapshot():
+    telemetry.gauge("health.worker.clock", worker=0).set(5)
+    telemetry.counter("ps.commits").inc(3)
+    telemetry.histogram("window_s").record(0.1)
+    telemetry.histogram("window_s").record(0.3)
+    text = export.snapshot_to_prometheus(
+        telemetry.get_registry().snapshot())
+    assert "# TYPE health_worker_clock gauge" in text
+    assert 'health_worker_clock{worker="0"} 5' in text
+    assert "# TYPE ps_commits counter" in text
+    assert "ps_commits 3" in text
+    assert "# TYPE window_s summary" in text
+    assert 'window_s{quantile="0.5"}' in text
+    assert "window_s_count 2" in text
+    assert text.endswith("\n")
+
+
+def test_prometheus_escapes_label_values_and_sanitises_names():
+    rows = [{"kind": "gauge", "name": "a.b-c", "value": 1.5,
+             "labels": {"path": 'x"y\\z'}}]
+    text = export.rows_to_prometheus(rows)
+    assert "# TYPE a_b_c gauge" in text
+    assert 'a_b_c{path="x\\"y\\\\z"} 1.5' in text
+
+
+def test_chrome_trace_units_and_series_tracks(tmp_path):
+    rows = [
+        {"kind": "span", "name": "fold", "t0": 1.0, "dur_s": 0.5,
+         "labels": {"worker": "0"}},
+        {"kind": "span", "name": "fold", "t0": 2.0, "dur_s": 0.25,
+         "labels": {"worker": "1"}},
+        {"kind": "gauge", "name": "skip.me", "value": 1.0, "labels": {}},
+    ]
+    trace = export.chrome_trace(rows)
+    evs = trace["traceEvents"]
+    assert len(evs) == 2  # the gauge row is trace-irrelevant
+    assert evs[0]["ts"] == 1e6 and evs[0]["dur"] == 500000.0
+    assert evs[0]["ph"] == "X"
+    assert evs[0]["tid"] != evs[1]["tid"]  # one track per series
+    path = export.write_chrome_trace(str(tmp_path / "t.json"), rows)
+    assert len(json.load(open(path))["traceEvents"]) == 2
+
+
+def test_snapshot_rows_roundtrip_key_parsing():
+    telemetry.counter("c", a=1, b="x").inc()
+    rows = export.snapshot_to_rows(telemetry.get_registry().snapshot())
+    row = next(r for r in rows if r["name"] == "c")
+    assert row["labels"] == {"a": "1", "b": "x"}
+    assert row["value"] == 1
+
+
+# -- satellite: truncated trailing JSONL line --------------------------------
+
+def test_load_jsonl_tolerates_truncated_trailing_line(tmp_path):
+    telemetry.counter("c").inc()
+    path = str(tmp_path / "run.telemetry.jsonl")
+    telemetry.get_registry().dump_jsonl(path)
+    with open(path) as f:
+        n_full = len(f.readlines())
+    with open(path, "a") as f:
+        f.write('{"kind": "gauge", "name": "cut-off-mid-wr')
+    with pytest.warns(RuntimeWarning, match="truncated trailing line"):
+        rows = telemetry.load_jsonl(path)
+    assert len(rows) == n_full
+
+    # corruption BEFORE the last line still raises
+    bad = str(tmp_path / "bad.jsonl")
+    with open(bad, "w") as f:
+        f.write('{"broken\n{"kind": "meta"}\n')
+    with pytest.raises(json.JSONDecodeError):
+        telemetry.load_jsonl(bad)
+
+
+# -- endpoint handler (no socket) -------------------------------------------
+
+def test_handle_health_op_status_digest():
+    now = time.time()
+    hb = HeartbeatPublisher(time_fn=lambda: now - 100.0)  # stale worker
+    hb.publish(worker=0, clock=3, staleness=1.0, window_s=0.2)
+    hb2 = HeartbeatPublisher(time_fn=lambda: now)
+    hb2.publish(worker=1, clock=4, staleness=0.0, window_s=0.2)
+    det = StragglerDetector(k=3.0, min_samples=1)
+    for _ in range(2):
+        det.observe(0, 0.1)
+    det.observe(1, 1.0)
+
+    status = endpoints.handle_health_op(
+        "status", {}, extra_status={"service": "test", "clock": 9})
+    assert status["service"] == "test" and status["clock"] == 9
+    w0, w1 = status["workers"]["0"], status["workers"]["1"]
+    assert w0["late"] and not w1["late"]  # 100s > LATE_HEARTBEAT_S
+    assert w0["clock"] == 3 and w0["windows"] == 1
+    assert status["stragglers"] == ["1"]
+    assert not status["watchdog_tripped"]
+    # per-worker counters live in the digest, not the flat counter dict
+    assert not any(k.startswith("health.worker.")
+                   for k in status["counters"])
+
+
+def test_handle_health_op_snapshot_spans_and_errors():
+    telemetry.counter("x").inc()
+    telemetry.get_registry().record_span("s", t0=0.0, dur_s=0.1, labels={})
+    out = endpoints.handle_health_op("metrics-snapshot", {})
+    assert out["snapshot"]["counters"]["x"] == 1
+    out = endpoints.handle_health_op("recent-spans", {"limit": 5})
+    assert out["spans"][0]["name"] == "s"
+    assert "error" in endpoints.handle_health_op("bogus", {})
+    telemetry.uninstall()
+    try:
+        assert "error" in endpoints.handle_health_op("status", {})
+    finally:
+        telemetry.reset()
+
+
+# -- live endpoints over loopback sockets ------------------------------------
+
+def _ps_service(token=None):
+    import jax
+
+    from distkeras_tpu.parameter_servers import DeltaParameterServer
+    from distkeras_tpu.parallel.remote_ps import ParameterServerService
+
+    params = {"w": np.ones((4, 3), np.float32)}
+    ps = DeltaParameterServer(jax.device_put(params))
+    svc = ParameterServerService(ps, params, token=token)
+    svc.start()
+    return ps, svc
+
+
+def test_health_ops_on_parameter_server_service():
+    ps, svc = _ps_service(token="s3cret")
+    try:
+        telemetry.counter("ps.commit").inc(2)
+        with HealthClient(f"127.0.0.1:{svc.port}", token="s3cret") as cli:
+            status = cli.status()
+            assert status["service"] == "parameter_server"
+            assert status["clock"] == 0
+            assert "uptime_s" in status
+            snap = cli.metrics_snapshot()
+            assert snap["counters"]["ps.commit"] == 2
+            telemetry.get_registry().record_span("fold", 0.0, 0.01, {})
+            assert cli.recent_spans(limit=3)[0]["name"] == "fold"
+        # the shared-token auth covers the health ops too
+        with HealthClient(f"127.0.0.1:{svc.port}", token="wrong") as bad:
+            with pytest.raises(RuntimeError, match="authentication"):
+                bad.status()
+    finally:
+        svc.stop()
+
+
+def test_health_ops_on_serving_server():
+    import jax
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.serving import (ServingClient, ServingEngine,
+                                       ServingServer)
+
+    model = MLP(features=(8,), num_classes=4)
+    params = model.init(jax.random.key(0), jnp.zeros((2, 16)),
+                        train=False)["params"]
+    eng = ServingEngine(model, params, input_shape=(16,), buckets=(1, 8),
+                        max_wait_ms=2.0)
+    srv = ServingServer(eng, host="127.0.0.1")
+    srv.start()
+    try:
+        rows = np.zeros((3, 16), np.float32)
+        scli = ServingClient(f"127.0.0.1:{srv.port}")
+        scli.infer(rows)
+        scli.close()
+        with HealthClient(f"127.0.0.1:{srv.port}") as cli:
+            status = cli.status()
+            assert status["service"] == "serving"
+            # satellite f: engine queue stats ride the status reply
+            assert status["queue_depth"] == 0
+            assert "oldest_request_age_s" in status
+            assert status["queue_capacity"] > 0
+            snap = cli.metrics_snapshot()
+            assert snap["counters"]["serving.completed"] == 3
+            assert "serving.queue_depth" in snap["gauges"]
+    finally:
+        srv.stop()
+        eng.shutdown()
+
+
+def test_cli_status_and_prom_against_live_service(capsys):
+    ps, svc = _ps_service()
+    try:
+        telemetry.gauge("health.stragglers").set(0.0)
+        rc = health_cli.main([f"127.0.0.1:{svc.port}", "status"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["service"] == "parameter_server"
+        rc = health_cli.main([f"127.0.0.1:{svc.port}", "metrics",
+                              "--format", "prom"])
+        assert rc == 0
+        assert "# TYPE health_stragglers gauge" in capsys.readouterr().out
+        rc = health_cli.main([f"127.0.0.1:{svc.port}", "watch",
+                              "--count", "2", "--interval", "0.01"])
+        assert rc == 0
+        assert capsys.readouterr().out.count("watchdog=ok") == 2
+    finally:
+        svc.stop()
+
+
+# -- integration: live run polled mid-flight ---------------------------------
+
+def _downpour_fixture(workers=2, window=2, batch=16, n=1024):
+    import jax
+    import jax.numpy as jnp
+
+    from distkeras_tpu import DOWNPOUR, synthetic_mnist
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.parallel import host_async
+
+    model = MLP(features=(32,), num_classes=10)
+    t = DOWNPOUR(model, mode="host_async", num_workers=workers,
+                 worker_optimizer="sgd", learning_rate=0.05, metrics=(),
+                 batch_size=batch, communication_window=window)
+    shards = host_async.stage_worker_shards(
+        synthetic_mnist(n=n).repartition(workers), "features", "label",
+        batch, window)
+    params = model.init(jax.random.key(0), jnp.zeros((batch, 784)),
+                        train=False)["params"]
+    runner = host_async.HostAsyncRunner(
+        model, "categorical_crossentropy", t.tx, t.strategy, window=window)
+    return model, params, shards, runner, t
+
+
+def test_live_introspection_during_host_async_run():
+    """ISSUE acceptance: start a HostAsyncRunner job, query the live
+    endpoint from another thread mid-run, and find worker heartbeats,
+    staleness histograms, and PS counters in the snapshot."""
+    import jax
+
+    from distkeras_tpu.parallel import host_async
+    from distkeras_tpu.parallel.remote_ps import ParameterServerService
+
+    model, params, shards, runner, t = _downpour_fixture()
+    ps = host_async.server_for(
+        t.strategy, jax.device_put(params, runner.devices[0]))
+    svc = ParameterServerService(ps, params, token="s3cret")
+    svc.start()
+    done = threading.Event()
+    errors = []
+
+    def train():
+        try:
+            runner.run(params, [shards] * 4, ps=ps)
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+        finally:
+            done.set()
+
+    polls = []
+    try:
+        with HealthClient(f"127.0.0.1:{svc.port}", token="s3cret") as cli:
+            threading.Thread(target=train, daemon=True).start()
+            while not done.wait(timeout=0.05):
+                polls.append(cli.status())
+            snap = cli.metrics_snapshot()
+    finally:
+        svc.stop()
+    assert not errors, errors
+    assert polls, "the run finished before a single poll"
+    assert any(p["workers"] for p in polls), \
+        "no poll observed live worker heartbeats"
+    # final snapshot: every worker left a heartbeat + the staleness
+    # histogram and PS counters are present
+    for w in range(2):
+        assert f"health.worker.heartbeat_time{{worker={w}}}" \
+            in snap["gauges"]
+        assert snap["counters"][f"health.worker.windows{{worker={w}}}"] > 0
+    assert snap["histograms"]["ps.commit.staleness"]["count"] > 0
+    assert snap["counters"]["ps.commit.count"] > 0
+
+
+def test_nan_fault_trips_checkpoint_and_raise_with_snapshot(tmp_path):
+    """ISSUE acceptance: an injected NaN under checkpoint_and_raise writes
+    a crash-time checkpoint and aborts the run with the typed error."""
+    from distkeras_tpu import DOWNPOUR, synthetic_mnist
+    from distkeras_tpu.checkpoint import Checkpointer
+    from distkeras_tpu.models.mlp import MLP
+
+    fault.inject("host_async.window_loss", after=3)
+    ckdir = str(tmp_path / "crash")
+    model = MLP(features=(32,), num_classes=10)
+    t = DOWNPOUR(model, mode="host_async", num_workers=2,
+                 worker_optimizer="sgd", learning_rate=0.05, metrics=(),
+                 batch_size=16, communication_window=2, num_epoch=4,
+                 checkpoint_dir=ckdir,
+                 health=HealthConfig(policy="checkpoint_and_raise"))
+    with pytest.raises(NaNLoss, match="non-finite loss"):
+        t.train(synthetic_mnist(n=1024), "features", "label")
+    step = Checkpointer(ckdir).latest_step()
+    assert step is not None, "crash-time checkpoint was not written"
+
+
+def test_warn_policy_run_completes_and_publishes_heartbeats():
+    """health='warn' + NaN injection: the run must finish (policy never
+    aborts) with the trip recorded in telemetry."""
+    from distkeras_tpu import DOWNPOUR, synthetic_mnist
+    from distkeras_tpu.models.mlp import MLP
+
+    fault.inject("host_async.window_loss", after=2, count=1)
+    model = MLP(features=(32,), num_classes=10)
+    t = DOWNPOUR(model, mode="host_async", num_workers=2,
+                 worker_optimizer="sgd", learning_rate=0.05, metrics=(),
+                 batch_size=16, communication_window=2, num_epoch=2,
+                 health="warn")
+    with pytest.warns(RuntimeWarning, match="policy=warn"):
+        t.train(synthetic_mnist(n=512), "features", "label")
+    snap = telemetry.get_registry().snapshot()
+    assert snap["gauges"]["health.watchdog.tripped"] == 1.0
+    assert "health.worker.heartbeat_time{worker=0}" in snap["gauges"]
+
+
+def test_trainer_rejects_prebuilt_watchdog():
+    from distkeras_tpu import DOWNPOUR
+    from distkeras_tpu.models.mlp import MLP
+
+    with pytest.raises(TypeError, match="fresh watchdog"):
+        DOWNPOUR(MLP(features=(8,)), mode="host_async", num_workers=2,
+                 health=TrainingWatchdog())
