@@ -8,13 +8,20 @@ This probe is the other bound: ONE jitted scan of train steps on
 device-resident data — no staging in the timed window at all — giving the
 compute ceiling the trainer harness should approach on a real TPU host.
 
-Usage: python benchmarks/step_probe.py [vit|resnet|bert|cnn|gpt|all]
-       [--batch N] [--steps N]
+Usage: python benchmarks/step_probe.py [vit|resnet|bert|cnn|gpt|all|sweep]
+       [--batch N] [--steps N] [--accum 1,4] [--remat none,blocks]
+       [--find-max-batch]
 Prints one JSON line per model with samples/s and MFU (fetch-synced timing,
 analytic FLOPs — same methodology as bench.py, validated by
 observability.calibrate_peak). When --batch/--steps are not given, each
 family uses its CANONICAL settings (the ones its BASELINE.md floor is
 defined at — e.g. resnet needs batch 128, gpt OOMs above batch 8).
+
+``sweep`` mode is the memory-for-compute matrix (DESIGN.md §10): one JSON
+line per (model, accum_steps, remat) config with samples/s, XLA's static
+peak-scratch bytes (``memory_analysis`` — works on every backend), live
+peak HBM (``device.memory_stats`` — TPU only), and with --find-max-batch a
+doubling search for the largest batch each config can compile and run.
 """
 
 from __future__ import annotations
@@ -34,31 +41,30 @@ except ImportError:  # running from a source checkout: use the repo root
         os.path.abspath(__file__))))
 
 
-def probe(name: str, batch: int, steps: int = 8) -> dict:
-    import jax
+def build_family(name: str, batch: int, remat: str = "none") -> tuple:
+    """(model, loss, x, y) for one probe family; ``remat`` is threaded to
+    the model's rematerialization field (models/remat.py) where the family
+    has one (cnn has no block structure to checkpoint)."""
     import jax.numpy as jnp
-    import optax
-
-    from distkeras_tpu import engine, observability
 
     if name == "vit":
         from distkeras_tpu.models import vit_base
 
-        model, loss = vit_base(), "categorical_crossentropy"
+        model, loss = vit_base(remat=remat), "categorical_crossentropy"
         rng = np.random.default_rng(0)
         x = rng.integers(0, 256, (batch, 224, 224, 3), dtype=np.uint8)
         y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]
     elif name == "resnet":
         from distkeras_tpu.models import resnet50_nf
 
-        model, loss = resnet50_nf(), "categorical_crossentropy"
+        model, loss = resnet50_nf(remat=remat), "categorical_crossentropy"
         rng = np.random.default_rng(0)
         x = rng.integers(0, 256, (batch, 224, 224, 3), dtype=np.uint8)
         y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]
     elif name == "bert":
         from distkeras_tpu.models import bert_base
 
-        model, loss = bert_base(), "masked_lm"
+        model, loss = bert_base(remat=remat), "masked_lm"
         rng = np.random.default_rng(0)
         x = rng.integers(1, model.vocab_size, (batch, 128)).astype(np.int16)
         y = np.where(rng.random((batch, 128)) < 0.15, x, -1).astype(np.int16)
@@ -67,6 +73,8 @@ def probe(name: str, batch: int, steps: int = 8) -> dict:
         # ceiling is its shapes, not the harness — probe for completeness
         from distkeras_tpu.models import cifar10_cnn
 
+        if remat != "none":
+            raise ValueError("cnn has no block structure to rematerialize")
         model, loss = (cifar10_cnn(dtype=jnp.bfloat16),
                        "categorical_crossentropy")
         rng = np.random.default_rng(0)
@@ -80,7 +88,7 @@ def probe(name: str, batch: int, steps: int = 8) -> dict:
 
         model = CausalLM(vocab_size=50304, max_len=2048, num_layers=12,
                          num_heads=12, width=768, mlp_dim=3072,
-                         attention="flash")
+                         attention="flash", remat=remat)
         loss = "masked_lm"
         rng = np.random.default_rng(0)
         x = rng.integers(1, model.vocab_size, (batch, 2048)).astype(np.int32)
@@ -88,7 +96,17 @@ def probe(name: str, batch: int, steps: int = 8) -> dict:
                            axis=1)
     else:
         raise ValueError(f"unknown model {name!r}")
+    return model, loss, x, y
 
+
+def probe(name: str, batch: int, steps: int = 8) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distkeras_tpu import engine, observability
+
+    model, loss, x, y = build_family(name, batch)
     tx = optax.adamw(1e-3)
     grad_fn = engine.make_grad_fn(model, loss)
     xd, yd = jnp.asarray(x), jnp.asarray(y)
@@ -137,15 +155,146 @@ CANONICAL = {"vit": dict(batch=64, steps=96),
              "gpt": dict(batch=8, steps=24)}
 
 
+def _is_oom(e: BaseException) -> bool:
+    msg = str(e).upper()
+    return ("RESOURCE_EXHAUSTED" in msg or "OUT OF MEMORY" in msg
+            or "ALLOCATION" in msg and "FAILED" in msg)
+
+
+def sweep_probe(name: str, batch: int, steps: int, accum_steps: int,
+                remat: str, compile_only: bool = False) -> dict:
+    """One (model, accum, remat) cell of the memory-for-compute matrix.
+
+    Reports samples/s (fetch-synced, like :func:`probe`), XLA's static
+    peak-scratch bytes from ``memory_analysis`` (every backend — the
+    CPU-testable remat signal), and live peak HBM from ``memory_stats``
+    (TPU only). ``compile_only`` stops after compilation + the memory
+    numbers — the largest-batch search uses it so each doubling costs one
+    compile, not a timed run.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distkeras_tpu import engine, observability
+
+    if batch % accum_steps:
+        raise ValueError(f"accum_steps={accum_steps} must divide "
+                         f"batch={batch}")
+    model, loss, x, y = build_family(name, batch, remat=remat)
+    tx = optax.adamw(1e-3)
+    if accum_steps > 1:
+        grad_fn = engine.make_accum_grad_fn(model, loss, accum_steps)
+    else:
+        grad_fn = engine.make_grad_fn(model, loss)
+    xd, yd = jnp.asarray(x), jnp.asarray(y)
+    state = engine.create_train_state(model, jax.random.key(0),
+                                      {"features": xd}, tx)
+
+    @jax.jit
+    def run(params, opt_state, x, y):
+        def one(c, _):
+            p, o = c
+            (l, _), g = grad_fn(p, {"features": x, "labels": y}, None)
+            up, o = tx.update(g, o, p)
+            return (optax.apply_updates(p, up), o), l
+
+        (p, o), ls = jax.lax.scan(one, (params, opt_state), None,
+                                  length=steps)
+        return p, o, jnp.sum(ls)
+
+    out = {"model": name, "batch": batch, "accum_steps": accum_steps,
+           "remat": remat, "steps_per_call": steps}
+    compiled = run.lower(state.params, state.opt_state, xd, yd).compile()
+    mem = observability.compiled_memory_bytes(compiled)
+    if mem:
+        out["temp_bytes"] = mem["temp_bytes"]
+    if compile_only:
+        return out
+    p, o, s = compiled(state.params, state.opt_state, xd, yd)
+    float(np.asarray(s))  # settle (fetch = completion barrier)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        p, o, s = compiled(p, o, xd, yd)
+        float(np.asarray(s))
+        times.append(time.perf_counter() - t0)
+    dt = sorted(times)[1]
+    out["samples_per_sec"] = round(batch * steps / dt, 1)
+    hbm = observability.hbm_stats()  # live allocator peak — TPU only
+    if hbm:
+        out.update({f"hbm_{k}": v for k, v in hbm.items()})
+    return out
+
+
+def largest_batch(name: str, steps: int, accum_steps: int, remat: str,
+                  start: int, limit: int = 1 << 16) -> dict:
+    """Doubling search for the largest batch a config compiles AND runs.
+
+    Probes in-process, relying on XLA raising RESOURCE_EXHAUSTED cleanly
+    (it does on TPU; a failed allocation doesn't poison the client).
+    Meaningful on a real accelerator; on CPU the host allocator swaps long
+    before it raises, so the search is capped at ``limit``.
+    """
+    best, b = None, start
+    while b <= limit:
+        try:
+            sweep_probe(name, b, min(steps, 4), accum_steps, remat,
+                        compile_only=False)
+            best = b
+            b *= 2
+        except Exception as e:  # noqa: BLE001 — OOM probing is the point
+            if _is_oom(e):
+                break
+            raise
+    return {"model": name, "accum_steps": accum_steps, "remat": remat,
+            "largest_batch": best, "search_limit": limit}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("which", nargs="?", default="all",
-                    choices=list(CANONICAL) + ["all"])
+                    choices=list(CANONICAL) + ["all", "sweep"])
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--steps", type=int, default=None,
                     help="scanned steps per timed device call; keep the "
                          "call >=1s so the ~90ms tunnel dispatch is noise")
+    ap.add_argument("--model", default="resnet", choices=list(CANONICAL),
+                    help="sweep mode: which family to sweep")
+    ap.add_argument("--accum", default="1,4",
+                    help="sweep mode: comma-separated accum_steps values")
+    ap.add_argument("--remat", default="none,blocks",
+                    help="sweep mode: comma-separated remat policies")
+    ap.add_argument("--find-max-batch", action="store_true",
+                    help="sweep mode: also run the doubling largest-batch "
+                         "search per config (accelerator-backed runs)")
     args = ap.parse_args()
+    if args.which == "sweep":
+        cfg = dict(CANONICAL[args.model])
+        if args.batch is not None:
+            cfg["batch"] = args.batch
+        if args.steps is not None:
+            cfg["steps"] = args.steps
+        accums = [int(a) for a in args.accum.split(",")]
+        remats = [r.strip() for r in args.remat.split(",")]
+        failed = False
+        for remat in remats:
+            for accum in accums:
+                try:
+                    print(json.dumps(sweep_probe(
+                        args.model, cfg["batch"], cfg["steps"], accum,
+                        remat)), flush=True)
+                    if args.find_max_batch:
+                        print(json.dumps(largest_batch(
+                            args.model, cfg["steps"], accum, remat,
+                            start=cfg["batch"])), flush=True)
+                except Exception as e:
+                    failed = True
+                    print(json.dumps(
+                        {"model": args.model, "accum_steps": accum,
+                         "remat": remat,
+                         "error": f"{type(e).__name__}: {e}"}), flush=True)
+        sys.exit(1 if failed else 0)
     names = list(CANONICAL) if args.which == "all" else [args.which]
     for name in names:
         cfg = dict(CANONICAL[name])

@@ -7,9 +7,10 @@ jax/XLA: jit-compiled update steps, mesh-sharded replicas, and ICI collectives
 instead of TCP+pickle. See SURVEY.md for the layer-by-layer mapping.
 """
 
-__version__ = "0.6.0"
+__version__ = "0.7.0"
 
 from distkeras_tpu import telemetry
+from distkeras_tpu.utils.jax_compat import enable_compilation_cache
 from distkeras_tpu.data.dataset import Dataset, synthetic_mnist
 from distkeras_tpu.evaluators import AccuracyEvaluator, Evaluator, LossEvaluator
 from distkeras_tpu.predictors import ModelClassifier, ModelPredictor, Predictor
@@ -64,6 +65,7 @@ __all__ = [
     "SingleTrainer",
     "Trainer",
     "Transformer",
+    "enable_compilation_cache",
     "synthetic_mnist",
     "telemetry",
     "__version__",

@@ -44,16 +44,27 @@ def prefetch(it: Iterable[T], depth: int = 1) -> Iterator[T]:
     depth_gauge = telemetry.gauge("data.prefetch.queue_depth")
     depth_hist = telemetry.histogram("data.prefetch.queue_depth_samples")
     wait_hist = telemetry.histogram("data.prefetch.producer_wait_s")
+    puts = telemetry.counter("data.prefetch.puts")
+    # one poll interval of the give-up loop below: an uncontended put
+    # completes well inside this, so only waits beyond it are real
+    # backpressure (recording every put drowned the histogram in ~0 s
+    # fast-path samples and dragged the reported mean toward zero)
+    _POLL_S = 0.1
 
     def _put(item) -> bool:
         """put that gives up when the consumer is gone."""
         t0 = time.perf_counter()
         while not abandoned.is_set():
             try:
-                q.put(item, timeout=0.1)
-                # time the producer sat blocked on a full queue (plus one
-                # enqueue): the backpressure the bounded buffer applies
-                wait_hist.record(time.perf_counter() - t0)
+                q.put(item, timeout=_POLL_S)
+                puts.inc()
+                # time the producer sat blocked on a full queue — the
+                # backpressure the bounded buffer applies. Uncontended
+                # fast-path puts (shorter than one poll interval) are
+                # counted by `puts` but kept out of the histogram.
+                waited = time.perf_counter() - t0
+                if waited > _POLL_S:
+                    wait_hist.record(waited)
                 return True
             except queue.Full:
                 continue

@@ -76,6 +76,39 @@ def make_loss_fn(model, loss) -> Callable:
     return compute
 
 
+def compute_metric_terms(name: str, logits: jax.Array,
+                         labels: jax.Array) -> tuple:
+    """(numerator, denominator) f32 pair of one metric over one (micro)batch.
+
+    The pair is SUMMABLE: adding the terms of k microbatches and finalizing
+    (:func:`finalize_metric`) gives exactly the metric of the concatenated
+    batch — the property gradient accumulation needs, which a mean of
+    per-microbatch ratios does NOT have for masked accuracy (microbatches
+    carry different valid-position counts).
+    """
+    if name in ("accuracy", "acc", "categorical_accuracy", "masked_accuracy"):
+        pred = jnp.argmax(logits, axis=-1)
+        if labels.ndim == logits.ndim - 1:  # integer labels
+            valid = labels >= 0
+            hit = jnp.where(valid, (pred == labels), False)
+            return (jnp.sum(hit.astype(jnp.float32)),
+                    jnp.sum(valid.astype(jnp.float32)))
+        true = jnp.argmax(labels, axis=-1)
+        return (jnp.sum((pred == true).astype(jnp.float32)),
+                jnp.float32(pred.size))
+    if name == "loss":  # already reported separately
+        raise ValueError("'loss' is always recorded; don't list it in metrics")
+    raise ValueError(f"Unknown metric {name!r}; supported: 'accuracy', "
+                     "'masked_accuracy'")
+
+
+def finalize_metric(terms: tuple) -> jax.Array:
+    """num/den of accumulated metric terms (den clamped: an all-masked
+    batch reports 0, not NaN)."""
+    num, den = terms
+    return num / jnp.maximum(den, 1.0)
+
+
 def compute_metric(name: str, logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Keras-style training metrics over one batch.
 
@@ -83,46 +116,142 @@ def compute_metric(name: str, logits: jax.Array, labels: jax.Array) -> jax.Array
     ignore convention) so 'accuracy' is meaningful for MLM training too;
     'masked_accuracy' is an explicit alias.
     """
-    if name in ("accuracy", "acc", "categorical_accuracy", "masked_accuracy"):
-        pred = jnp.argmax(logits, axis=-1)
-        if labels.ndim == logits.ndim - 1:  # integer labels
-            valid = labels >= 0
-            hit = jnp.where(valid, (pred == labels), False)
-            return jnp.sum(hit.astype(jnp.float32)) / jnp.maximum(
-                jnp.sum(valid.astype(jnp.float32)), 1.0)
-        true = jnp.argmax(labels, axis=-1)
-        return jnp.mean((pred == true).astype(jnp.float32))
-    if name == "loss":  # already reported separately
-        raise ValueError("'loss' is always recorded; don't list it in metrics")
-    raise ValueError(f"Unknown metric {name!r}; supported: 'accuracy', "
-                     "'masked_accuracy'")
+    return finalize_metric(compute_metric_terms(name, logits, labels))
 
 
 def make_train_step(model, loss, tx: optax.GradientTransformation,
                     with_metrics: bool = True,
                     metrics: tuple = (),
-                    dropout_seed: int = 0) -> Callable:
+                    dropout_seed: int = 0,
+                    accum_steps: int = 1) -> Callable:
     """Build the jitted single-replica train step.
 
     Returns ``step(state, batch) -> (state, metrics)`` where metrics is a dict
     of scalar device arrays (loss, grad_norm, requested metrics). Already
     jitted with donated state. A per-step dropout rng is derived by folding
     the step counter into ``dropout_seed``, so stochastic layers just work.
+
+    ``accum_steps=k`` splits each batch into k microbatches scanned
+    sequentially, summing gradients in f32 and applying the optimizer ONCE —
+    the memory-for-compute trade (NUMERICS.md: equals the full-batch step on
+    the mean-loss objective). The batch's leading dim must be divisible by k.
     """
     one_step = _make_step_body(model, loss, tx, with_metrics, metrics,
-                               dropout_seed)
+                               dropout_seed, accum_steps)
     return jax.jit(one_step, donate_argnums=(0,))
+
+
+def _split_microbatches(batch: Batch, k: int) -> Batch:
+    """[k*m, ...] batch leaves -> [k, m, ...]; loud error on a ragged split."""
+
+    def split(x):
+        b = x.shape[0]
+        if b % k != 0:
+            raise ValueError(
+                f"accum_steps={k} must divide the per-step batch "
+                f"(got a leaf with leading dim {b})")
+        return x.reshape((k, b // k) + x.shape[1:])
+
+    return jax.tree.map(split, batch)
+
+
+def make_accum_grad_fn(model, loss, accum_steps: int,
+                       metric_names: tuple = ()) -> Callable:
+    """Gradient-accumulation counterpart of :func:`make_grad_fn`, same
+    contract: ``(params, batch, rngs) -> ((loss, aux), grads)`` — so every
+    strategy's ``local_step`` composes with it unchanged.
+
+    The [k*m, ...] batch is scanned as k microbatches of m rows; per-
+    microbatch grads are summed in f32 and divided by k, which equals the
+    full-batch mean-loss gradient exactly (equal microbatch sizes make the
+    mean of means the overall mean). Peak activation memory is that of ONE
+    microbatch. ``aux`` is ``{metric: (num, den)}`` f32 term pairs (see
+    :func:`compute_metric_terms`) rather than logits — re-materializing
+    full-batch logits (for MLM, [batch, seq, vocab]) would hand back the
+    memory the microbatching just saved.
+
+    The dropout key is folded per microbatch index, so stochastic layers
+    see k independent masks (they cannot see the one full-batch mask — the
+    parity guarantee is for the deterministic objective; see NUMERICS.md).
+
+    Aux losses sown from batch statistics (e.g. the Switch-MoE load-balance
+    term) are computed per microbatch and averaged — a batch-statistics
+    dependence analogous to BatchNorm's, documented rather than hidden.
+    """
+    compute_loss = make_loss_fn(model, loss)
+    k = int(accum_steps)
+    if k < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    metric_names = tuple(metric_names)
+
+    def grad_fn(params, batch: Batch, rngs: Optional[dict] = None):
+        micro = _split_microbatches(batch, k)
+
+        def body(acc, xs):
+            batch_i, i = xs
+            rngs_i = None if rngs is None else {
+                name: jax.random.fold_in(key, i)
+                for name, key in rngs.items()}
+            (l, logits), g = jax.value_and_grad(compute_loss, has_aux=True)(
+                params, batch_i, rngs_i)
+            terms = {name: compute_metric_terms(name, logits,
+                                                batch_i["labels"])
+                     for name in metric_names}
+            loss_acc, terms_acc, grads_acc = acc
+            grads_acc = jax.tree.map(
+                lambda a, gi: a + gi.astype(jnp.float32), grads_acc, g)
+            terms_acc = jax.tree.map(lambda a, t: a + t, terms_acc, terms)
+            return (loss_acc + l.astype(jnp.float32), terms_acc,
+                    grads_acc), None
+
+        zeros_like_f32 = lambda t: jax.tree.map(
+            lambda x: jnp.zeros(jnp.shape(x), jnp.float32), t)
+        init = (jnp.float32(0.0),
+                {name: (jnp.float32(0.0), jnp.float32(0.0))
+                 for name in metric_names},
+                zeros_like_f32(params))
+        (loss_sum, terms, grad_sum), _ = jax.lax.scan(
+            body, init, (micro, jnp.arange(k, dtype=jnp.int32)))
+        grads = jax.tree.map(
+            lambda g, p: (g / k).astype(jnp.asarray(p).dtype),
+            grad_sum, params)
+        return (loss_sum / k, terms), grads
+
+    return grad_fn
 
 
 def _make_step_body(model, loss, tx: optax.GradientTransformation,
                     with_grad_norm: bool, metrics: tuple,
-                    dropout_seed: int) -> Callable:
+                    dropout_seed: int, accum_steps: int = 1) -> Callable:
     """The ONE unjitted step body shared by :func:`make_train_step` and
     :func:`make_epoch_fn` — keeping them numerically identical by
-    construction, not by hand-synced copies."""
-    compute_loss = make_loss_fn(model, loss)
-    base_key = jax.random.key(dropout_seed)
+    construction, not by hand-synced copies. ``accum_steps > 1`` swaps the
+    full-batch grad for the scanned microbatch accumulation
+    (:func:`make_accum_grad_fn`); the optimizer still applies once per step,
+    so ``state.step`` counts OPTIMIZER steps either way."""
     metric_names = tuple(metrics)
+    base_key = jax.random.key(dropout_seed)
+    accum_steps = int(accum_steps)
+    if accum_steps > 1:
+        accum_grad = make_accum_grad_fn(model, loss, accum_steps,
+                                        metric_names)
+
+        def one_step(state: TrainState, batch: Batch):
+            rngs = {"dropout": jax.random.fold_in(base_key, state.step)}
+            (loss_val, terms), grads = accum_grad(state.params, batch, rngs)
+            updates, opt_state = tx.update(grads, state.opt_state,
+                                           state.params)
+            params = optax.apply_updates(state.params, updates)
+            out = {"loss": loss_val}
+            if with_grad_norm:
+                out["grad_norm"] = global_norm(grads)
+            for name in metric_names:
+                out[name] = finalize_metric(terms[name])
+            return TrainState(step=state.step + 1, params=params,
+                              opt_state=opt_state), out
+
+        return one_step
+    compute_loss = make_loss_fn(model, loss)
 
     def one_step(state: TrainState, batch: Batch):
         rngs = {"dropout": jax.random.fold_in(base_key, state.step)}
@@ -142,7 +271,8 @@ def _make_step_body(model, loss, tx: optax.GradientTransformation,
 
 
 def make_epoch_fn(model, loss, tx: optax.GradientTransformation,
-                  metrics: tuple = (), dropout_seed: int = 0) -> Callable:
+                  metrics: tuple = (), dropout_seed: int = 0,
+                  accum_steps: int = 1) -> Callable:
     """Scanned single-replica epoch: the whole staged chunk in ONE device
     call.
 
@@ -151,9 +281,11 @@ def make_epoch_fn(model, loss, tx: optax.GradientTransformation,
     identical to looping :func:`make_train_step` over the same batches by
     construction — both scan/loop the same :func:`_make_step_body` — but a
     whole epoch costs one dispatch instead of one per step (which on
-    tunneled backends is ~100x the difference).
+    tunneled backends is ~100x the difference). ``accum_steps=k`` microbatches
+    each step (see :func:`make_train_step`).
     """
-    one_step = _make_step_body(model, loss, tx, True, metrics, dropout_seed)
+    one_step = _make_step_body(model, loss, tx, True, metrics, dropout_seed,
+                               accum_steps)
 
     def epoch(state: TrainState, data: Batch):
         return jax.lax.scan(one_step, state, data)

@@ -107,6 +107,14 @@ def handle_health_op(op: str, header: dict,
                          snap.get("counters", {}).items()
                          if not k.startswith("health.worker.")},
         }
+        # device-memory digest: observability.hbm_stats() publishes the
+        # PJRT allocator counters as gauges, so the status op can report
+        # HBM pressure without this module ever importing jax
+        hbm = {key[len("observability.hbm_"):]: int(value)
+               for key, value in gauges.items()
+               if key.startswith("observability.hbm_")}
+        if hbm:
+            status["hbm"] = hbm
         if extra_status:
             status.update(extra_status)
         return status

@@ -1,6 +1,7 @@
 from distkeras_tpu.models.bert import BertMLM, bert_base, bert_tiny
 from distkeras_tpu.models.cnn import CIFARConvNet, cifar10_cnn
 from distkeras_tpu.models.mlp import MLP, mnist_mlp
+from distkeras_tpu.models.remat import REMAT_POLICIES, remat_wrap
 from distkeras_tpu.models.resnet import (
     ResNet,
     resnet18,
@@ -15,8 +16,10 @@ __all__ = [
     "BertMLM",
     "CIFARConvNet",
     "MLP",
+    "REMAT_POLICIES",
     "ResNet",
     "ViT",
+    "remat_wrap",
     "bert_base",
     "bert_tiny",
     "cifar10_cnn",

@@ -30,6 +30,9 @@ class BertMLM(nn.Module):
     dropout_rate: float = 0.0
     pad_id: int = 0
     dtype: jnp.dtype = jnp.bfloat16
+    #: activation rematerialization policy for the encoder blocks
+    #: (models/remat.py)
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, input_ids, train: bool = False, segment_ids=None):
@@ -48,8 +51,8 @@ class BertMLM(nn.Module):
 
         mask = ids != self.pad_id  # [b, seq] key-side padding mask
         x = Encoder(self.num_layers, self.num_heads, self.mlp_dim,
-                    self.dropout_rate, self.dtype, name="encoder")(
-            x, mask=mask, train=train)
+                    self.dropout_rate, self.dtype, remat=self.remat,
+                    name="encoder")(x, mask=mask, train=train)
 
         # MLM head: transform + tied-style output projection
         x = nn.Dense(self.width, dtype=self.dtype, name="mlm_dense")(x)

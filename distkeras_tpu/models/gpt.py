@@ -23,6 +23,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from distkeras_tpu.models.remat import remat_wrap
 from distkeras_tpu.models.transformer import MlpBlock
 from distkeras_tpu.ops.attention import dot_product_attention
 from distkeras_tpu.ops.ring_attention import ring_attention
@@ -87,13 +88,17 @@ class CausalLM(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     attention: str = "full"
     axis_name: str = "seq"
+    #: activation rematerialization policy for the decoder blocks
+    #: (models/remat.py); "full" also wraps the token embedding.
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, input_ids, train: bool = False):
         ids = input_ids.astype(jnp.int32)
         b, t = ids.shape  # t = LOCAL block length under sequence parallelism
-        x = nn.Embed(self.vocab_size, self.width, dtype=self.dtype,
-                     name="tok_embed")(ids)
+        embed_cls = remat_wrap(nn.Embed, self.remat, stem=True)
+        x = embed_cls(self.vocab_size, self.width, dtype=self.dtype,
+                      name="tok_embed")(ids)
         pos_table = self.param("pos_embed", nn.initializers.normal(0.02),
                                (self.max_len, self.width))
         if self.attention == "ring":
@@ -111,10 +116,12 @@ class CausalLM(nn.Module):
         else:
             pos = pos_table[:t]
         x = x + pos.astype(self.dtype)
+        # positional call, train static at index 2 (models/remat.py rules)
+        block_cls = remat_wrap(DecoderBlock, self.remat, static_argnums=(2,))
         for i in range(self.num_layers):
-            x = DecoderBlock(self.num_heads, self.mlp_dim, self.dtype,
-                             self.attention, self.axis_name,
-                             name=f"layer_{i}")(x, train=train)
+            x = block_cls(self.num_heads, self.mlp_dim, self.dtype,
+                          self.attention, self.axis_name,
+                          name=f"layer_{i}")(x, train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,
                           name="lm_head")(x)

@@ -18,6 +18,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from distkeras_tpu.models.remat import remat_wrap
 from distkeras_tpu.models.transformer import MlpBlock
 
 
@@ -124,16 +125,23 @@ class MoEClassifier(nn.Module):
     capacity_factor: float = 2.0
     dtype: jnp.dtype = jnp.bfloat16
     aux_loss_weight: float = 0.01
+    #: activation rematerialization policy for the MoE blocks
+    #: (models/remat.py); the sown aux loss and router rng pass through
+    #: the lifted transform unchanged.
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
+        # positional call, train static at index 2 (models/remat.py rules)
+        block_cls = remat_wrap(MoEEncoderBlock, self.remat,
+                               static_argnums=(2,))
         for i in range(self.num_layers):
-            x = MoEEncoderBlock(
+            x = block_cls(
                 num_heads=self.num_heads, num_experts=self.num_experts,
                 mlp_dim=self.mlp_dim, capacity_factor=self.capacity_factor,
                 dtype=self.dtype, aux_loss_weight=self.aux_loss_weight,
-                name=f"block{i}")(x, train=train)
+                name=f"block{i}")(x, train)
         x = jnp.mean(x.astype(jnp.float32), axis=1)  # pool over tokens
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
 

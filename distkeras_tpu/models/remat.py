@@ -1,0 +1,70 @@
+"""Activation rematerialization policies — the models' half of the
+memory-for-compute layer (DESIGN.md §10).
+
+Every model family exposes ``remat=`` taking one of :data:`REMAT_POLICIES`:
+
+``none``
+    Save every activation (XLA's default autodiff behavior).
+``blocks``
+    Wrap each residual block / encoder layer in ``jax.checkpoint`` with the
+    default nothing-saveable policy: the backward pass recomputes the block
+    forward from its input, so live activations are O(depth) block
+    BOUNDARIES instead of O(depth) block INTERIORS (Chen et al. 2016).
+``dots_saveable``
+    Same block wrapping, but XLA may keep matmul outputs
+    (``jax.checkpoint_policies.dots_saveable``) — cheaper recompute than
+    ``blocks`` at higher memory; the middle ground when ``blocks``' full
+    recompute shows up in step time.
+``full``
+    ``blocks`` plus the pre-block heavy modules (e.g. the ResNet stem conv,
+    whose [B, 112, 112, 64] activation is the single largest in the net) —
+    maximum savings, maximum recompute.
+
+Mechanics: flax's ``nn.remat`` lifts ``jax.checkpoint`` onto a Module
+class. Two calling-convention rules this module centralizes so each model
+doesn't rediscover them:
+
+- ``static_argnums`` indexes include ``self`` at position 0 (so ``train``
+  in ``__call__(self, x, train=False)`` is index 2);
+- a remat-wrapped module must be called with ALL-POSITIONAL arguments
+  (keyword args break ``jax.checkpoint``'s static_argnums resolution) —
+  the in-tree call sites pass positionally whether or not remat is on, so
+  both paths stay byte-identical in structure.
+
+Sown collections (the Switch-MoE aux loss) and dropout rngs pass through
+the lifted transform unchanged (``variables=True, rngs=True`` defaults).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+
+REMAT_POLICIES = ("none", "blocks", "dots_saveable", "full")
+
+
+def validate_remat(remat: str) -> str:
+    if remat not in REMAT_POLICIES:
+        raise ValueError(f"remat must be one of {REMAT_POLICIES}, "
+                         f"got {remat!r}")
+    return remat
+
+
+def checkpoint_policy(remat: str):
+    """The jax.checkpoint policy for a remat mode (None = save nothing)."""
+    if remat == "dots_saveable":
+        return jax.checkpoint_policies.dots_saveable
+    return None
+
+
+def remat_wrap(module_cls, remat: str, *, static_argnums=(),
+               stem: bool = False):
+    """Wrap a Module class in ``nn.remat`` per the policy, or return it
+    unchanged. ``stem=True`` marks pre-block modules that only the ``full``
+    policy wraps. ``static_argnums`` counts ``self`` at index 0; wrapped
+    modules must be called all-positionally (module docstring)."""
+    validate_remat(remat)
+    if remat == "none" or (stem and remat != "full"):
+        return module_cls
+    return nn.remat(module_cls, policy=checkpoint_policy(remat),
+                    static_argnums=tuple(static_argnums))

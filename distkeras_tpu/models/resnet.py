@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from distkeras_tpu.models.input_norm import normalize_image_input
+from distkeras_tpu.models.remat import remat_wrap
 
 ModuleDef = Any
 
@@ -228,10 +229,15 @@ class ResNet(nn.Module):
     #: better than a 3-channel input (the classic MLPerf ResNet trick).
     #: Requires even H and W.
     space_to_depth: bool = False
+    #: activation rematerialization policy (models/remat.py): "blocks"
+    #: checkpoints each residual block, "full" also wraps the stem conv
+    #: (whose [B, 112, 112, 64] output is the single largest activation).
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         del train  # stateless norms: train/eval forward passes are identical
+        block_cls = remat_wrap(self.block, self.remat)
         x = normalize_image_input(x, self.dtype, self.normalize_uint8)
         if self.space_to_depth:
             n, h, w, c = x.shape
@@ -243,29 +249,33 @@ class ResNet(nn.Module):
             stem_kernel, stem_strides = (7, 7), (2, 2)
             stem_pad = ((3, 3), (3, 3))
         if self.norm == "nf":
-            x = ScaledWSConv(self.width, stem_kernel, strides=stem_strides,
-                             padding=stem_pad, dtype=self.dtype,
-                             name="conv_stem")(x)
+            stem_conv = remat_wrap(ScaledWSConv, self.remat, stem=True)
+            x = stem_conv(self.width, stem_kernel, strides=stem_strides,
+                          padding=stem_pad, dtype=self.dtype,
+                          name="conv_stem")(x)
             x = nn.relu(x) * _RELU_GAIN
         elif self.space_to_depth:
-            x = nn.Conv(self.width, stem_kernel, strides=stem_strides,
-                        padding=stem_pad, use_bias=False, dtype=self.dtype,
-                        name="conv_stem")(x)
+            stem_conv = remat_wrap(nn.Conv, self.remat, stem=True)
+            x = stem_conv(self.width, stem_kernel, strides=stem_strides,
+                          padding=stem_pad, use_bias=False, dtype=self.dtype,
+                          name="conv_stem")(x)
             x = group_norm(self.width, dtype=self.dtype, name="norm_stem")(x)
             x = nn.relu(x)
         else:
-            x = nn.Conv(self.width, (7, 7), strides=(2, 2),
-                        padding=[(3, 3), (3, 3)],
-                        use_bias=False, dtype=self.dtype, name="conv_stem")(x)
+            stem_conv = remat_wrap(nn.Conv, self.remat, stem=True)
+            x = stem_conv(self.width, (7, 7), strides=(2, 2),
+                          padding=[(3, 3), (3, 3)],
+                          use_bias=False, dtype=self.dtype,
+                          name="conv_stem")(x)
             x = group_norm(self.width, dtype=self.dtype, name="norm_stem")(x)
             x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for i, num_blocks in enumerate(self.stage_sizes):
             for j in range(num_blocks):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = self.block(filters=self.width * 2 ** i, strides=strides,
-                               dtype=self.dtype, norm=self.norm,
-                               name=f"stage{i}_block{j}")(x)
+                x = block_cls(filters=self.width * 2 ** i, strides=strides,
+                              dtype=self.dtype, norm=self.norm,
+                              name=f"stage{i}_block{j}")(x)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
         return x.astype(jnp.float32)

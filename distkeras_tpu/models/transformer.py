@@ -13,6 +13,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from distkeras_tpu.models.remat import remat_wrap
 from distkeras_tpu.ops.attention import MultiHeadAttention
 
 
@@ -53,19 +54,27 @@ class EncoderBlock(nn.Module):
 
 
 class Encoder(nn.Module):
-    """Stack of encoder blocks with a final LayerNorm."""
+    """Stack of encoder blocks with a final LayerNorm.
+
+    ``remat`` checkpoints each block (models/remat.py). Blocks are called
+    ALL-POSITIONALLY — a remat-wrapped module rejects keyword args, and one
+    call shape for both paths keeps them structurally identical. ``train``
+    is static (position 3, counting ``self``): a traced bool would fail the
+    dropout branch's Python ``if``.
+    """
 
     num_layers: int
     num_heads: int
     mlp_dim: int
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.bfloat16
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, x, mask: Optional[jax.Array] = None,
                  train: bool = False):
+        block_cls = remat_wrap(EncoderBlock, self.remat, static_argnums=(3,))
         for i in range(self.num_layers):
-            x = EncoderBlock(self.num_heads, self.mlp_dim, self.dropout_rate,
-                             self.dtype, name=f"layer_{i}")(
-                x, mask=mask, train=train)
+            x = block_cls(self.num_heads, self.mlp_dim, self.dropout_rate,
+                          self.dtype, name=f"layer_{i}")(x, mask, train)
         return nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)

@@ -11,6 +11,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from distkeras_tpu.models.input_norm import normalize_image_input
+from distkeras_tpu.models.remat import remat_wrap
 from distkeras_tpu.models.transformer import Encoder
 
 
@@ -28,13 +29,17 @@ class ViT(nn.Module):
     #: because config 5's end-to-end number is bound by image staging over
     #: the host->device link. No effect on float inputs.
     normalize_uint8: bool = True
+    #: activation rematerialization policy for the encoder blocks
+    #: (models/remat.py); "full" also wraps the patch embedding.
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = normalize_image_input(x, self.dtype, self.normalize_uint8)
         p = self.patch_size
-        x = nn.Conv(self.width, (p, p), strides=(p, p), padding="VALID",
-                    dtype=self.dtype, name="patch_embed")(x)
+        patch_conv = remat_wrap(nn.Conv, self.remat, stem=True)
+        x = patch_conv(self.width, (p, p), strides=(p, p), padding="VALID",
+                       dtype=self.dtype, name="patch_embed")(x)
         b, h, w, c = x.shape
         x = x.reshape((b, h * w, c))
         cls = self.param("cls", nn.initializers.zeros, (1, 1, self.width))
@@ -44,8 +49,8 @@ class ViT(nn.Module):
                          (1, h * w + 1, self.width))
         x = x + pos.astype(self.dtype)
         x = Encoder(self.num_layers, self.num_heads, self.mlp_dim,
-                    self.dropout_rate, self.dtype, name="encoder")(
-            x, train=train)
+                    self.dropout_rate, self.dtype, remat=self.remat,
+                    name="encoder")(x, train=train)
         cls_out = x[:, 0]
         return nn.Dense(self.num_classes, dtype=jnp.float32,
                         name="head")(cls_out).astype(jnp.float32)

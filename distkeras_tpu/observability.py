@@ -230,6 +230,62 @@ def mfu(flops_per_step: float, step_time_s: float, num_chips: int = 1,
     return value
 
 
+def hbm_stats(device: Optional[jax.Device] = None) -> Optional[dict]:
+    """Live HBM usage of one device, published as telemetry gauges.
+
+    Reads ``device.memory_stats()`` (PJRT allocator counters; None on CPU)
+    and mirrors the numbers into the registry as
+    ``observability.hbm_peak_bytes`` / ``observability.hbm_allocated_bytes``
+    / ``observability.hbm_limit_bytes`` — which is how they reach the
+    health ``status`` endpoint (health/endpoints.py may not import jax, so
+    it reads the gauges out of the registry snapshot, not the device).
+
+    Returns ``{"peak_bytes", "allocated_bytes", "limit_bytes"}`` (missing
+    counters omitted) or None when the backend has no allocator stats.
+    """
+    device = device or jax.devices()[0]
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        stats = None
+    if not stats:
+        return None
+    out = {}
+    for key, stat in (("peak_bytes", "peak_bytes_in_use"),
+                      ("allocated_bytes", "bytes_in_use"),
+                      ("limit_bytes", "bytes_limit")):
+        if stat in stats:
+            out[key] = int(stats[stat])
+            telemetry.gauge(f"observability.hbm_{key}").set(float(out[key]))
+    return out or None
+
+
+def compiled_memory_bytes(compiled) -> Optional[dict]:
+    """Static memory footprint of a compiled executable, per XLA's own
+    ``memory_analysis()`` — works on every backend including CPU, which
+    makes it the testable proxy for remat's peak-memory claim (live
+    ``memory_stats()`` needs a real accelerator allocator).
+
+    Returns ``{"temp_bytes", "argument_bytes", "output_bytes",
+    "generated_code_bytes"}`` or None when the backend doesn't report it.
+    ``temp_bytes`` is the interesting one: XLA's peak scratch allocation —
+    activations saved for the backward pass live there, so rematerialization
+    shows up directly as a smaller number.
+    """
+    try:
+        mem = compiled.memory_analysis()
+        if mem is None:
+            return None
+        return {
+            "temp_bytes": int(mem.temp_size_in_bytes),
+            "argument_bytes": int(mem.argument_size_in_bytes),
+            "output_bytes": int(mem.output_size_in_bytes),
+            "generated_code_bytes": int(mem.generated_code_size_in_bytes),
+        }
+    except Exception:
+        return None
+
+
 class StepTimer:
     """Wall-clock timing of compiled steps, blocking on device completion.
 

@@ -89,14 +89,26 @@ class CadenceTrigger:
 
 
 def make_window_fn(model, loss, tx, strategy: Strategy, window: int,
-                   metric_names: Sequence[str], seed: int):
+                   metric_names: Sequence[str], seed: int,
+                   accum_steps: int = 1):
     """One worker's compiled round: λ local steps + commit computation.
 
     (carry, center, batches, fold_key) -> (carry, commit, metrics dict)
     where batches leaves are [window, batch, ...]. Compiled once; every
     worker thread calls the same executable.
+
+    ``accum_steps > 1`` microbatches each of the λ local steps
+    (engine.make_accum_grad_fn). Accumulation lives entirely inside the
+    local step's grad fn, so a window is still λ optimizer steps and ONE
+    commit — server clock, commit counts, and staleness histograms are
+    unchanged by construction.
     """
-    grad_fn = engine.make_grad_fn(model, loss)
+    accum_steps = int(accum_steps)
+    if accum_steps > 1:
+        grad_fn = engine.make_accum_grad_fn(model, loss, accum_steps,
+                                            metric_names)
+    else:
+        grad_fn = engine.make_grad_fn(model, loss)
     base_key = jax.random.key(seed)
 
     def window_fn(carry, center, batches, fold_key):
@@ -109,8 +121,11 @@ def make_window_fn(model, loss, tx, strategy: Strategy, window: int,
                                        rngs={"dropout": rng})
             out = {"loss": m["loss"]}
             for name in metric_names:
-                out[name] = engine.compute_metric(name, m["logits"],
-                                                  batch["labels"])
+                if accum_steps > 1:
+                    out[name] = engine.finalize_metric(m["logits"][name])
+                else:
+                    out[name] = engine.compute_metric(name, m["logits"],
+                                                      batch["labels"])
             return c, out
 
         idx = jnp.arange(window, dtype=jnp.int32)
@@ -138,11 +153,14 @@ class HostAsyncRunner:
     def __init__(self, model, loss, tx, strategy: Strategy, window: int,
                  metrics: Sequence[str] = (), seed: int = 0,
                  devices: Optional[Sequence[jax.Device]] = None,
-                 codec: Optional[str] = None, overlap: bool = False):
+                 codec: Optional[str] = None, overlap: bool = False,
+                 accum_steps: int = 1):
         self.strategy = strategy
         self.window = int(window)
+        self.accum_steps = int(accum_steps)
         self.window_fn = make_window_fn(model, loss, tx, strategy, window,
-                                        tuple(metrics), seed)
+                                        tuple(metrics), seed,
+                                        accum_steps=self.accum_steps)
         self.tx = tx
         # worker k runs on devices[k % D]; default = single-device mode
         self.devices = list(devices) if devices else [jax.devices()[0]]

@@ -39,7 +39,7 @@ WORKERS = mesh_lib.WORKER_AXIS
 def build_epoch_fn(model, loss, tx: optax.GradientTransformation,
                    strategy: Strategy, mesh: Mesh, num_workers: int,
                    window: int, metrics: Sequence[str] = (),
-                   dropout_seed: int = 0) -> Callable:
+                   dropout_seed: int = 0, accum_steps: int = 1) -> Callable:
     """Compile the per-epoch distributed training function.
 
     ``num_workers`` is the LOGICAL worker count K; when it exceeds the mesh's
@@ -48,6 +48,12 @@ def build_epoch_fn(model, loss, tx: optax.GradientTransformation,
     executors). Logical worker k lives on device k // (K/D); the staleness
     rotation and the center fold run over all K, so K workers on D devices
     compute the same training trajectory as K workers on K devices.
+
+    ``accum_steps > 1`` turns each local step into a scan over that many
+    microbatches (engine.make_accum_grad_fn); the per-step batch is split on
+    its leading axis, so peak activation memory shrinks by ~accum_steps while
+    λ/window accounting is untouched — a window is still ``window`` optimizer
+    steps and one commit, and DynSGD staleness weights see the same schedule.
 
     Returns ``epoch_fn(center, carries, data, round_offset) ->
     (center, carries, metrics)`` where
@@ -62,8 +68,16 @@ def build_epoch_fn(model, loss, tx: optax.GradientTransformation,
     - ``metrics``: dict of (num_workers, rounds, window) float arrays plus
       per-round ``staleness`` (num_workers, rounds).
     """
-    grad_fn = engine.make_grad_fn(model, loss)
     metric_names = tuple(metrics)
+    accum_steps = int(accum_steps)
+    if accum_steps > 1:
+        # terms-accumulating grad fn: same (params, batch, rngs) contract,
+        # but aux is {metric: (num, den)} instead of logits — strategies
+        # pass it through opaquely, the step body finalizes below
+        grad_fn = engine.make_accum_grad_fn(model, loss, accum_steps,
+                                            metric_names)
+    else:
+        grad_fn = engine.make_grad_fn(model, loss)
     base_key = jax.random.key(dropout_seed)
     mesh_workers = mesh.shape[WORKERS]
     if num_workers % mesh_workers != 0:
@@ -97,8 +111,11 @@ def build_epoch_fn(model, loss, tx: optax.GradientTransformation,
                                            rngs={"dropout": rng})
                 out = {"loss": m["loss"]}
                 for name in metric_names:
-                    out[name] = engine.compute_metric(
-                        name, m["logits"], batch["labels"])
+                    if accum_steps > 1:
+                        out[name] = engine.finalize_metric(m["logits"][name])
+                    else:
+                        out[name] = engine.compute_metric(
+                            name, m["logits"], batch["labels"])
                 return c, out
 
             step_idx = jnp.arange(window, dtype=jnp.int32)

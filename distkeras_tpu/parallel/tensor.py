@@ -103,7 +103,7 @@ def shard_params(params: Any, mesh: Mesh,
 def build_pjit_epoch_fn(model, loss, tx: optax.GradientTransformation,
                         mesh: Mesh, metrics: Sequence[str] = (),
                         rules: Optional[Rules] = None,
-                        dropout_seed: int = 0):
+                        dropout_seed: int = 0, accum_steps: int = 1):
     """Sync data-parallel (× tensor-parallel) epoch: scan over staged steps.
 
     Returns ``(epoch_fn, place_state, place_data)``:
@@ -113,25 +113,39 @@ def build_pjit_epoch_fn(model, loss, tx: optax.GradientTransformation,
     - ``place_state(state)`` / ``place_data(data)`` put pytrees on the mesh
       with the matching shardings.
 
+    ``accum_steps > 1`` scans each step over that many microbatches
+    (engine.make_accum_grad_fn), splitting the per-step batch on its leading
+    axis — under GSPMD that axis is already sharded over ``workers``, so each
+    device accumulates over its own rows and the psum stays once per
+    optimizer step.
+
     This is the honest sync-DP fast path (BASELINE config 5): one compiled
     program, grads all-reduced by GSPMD, params optionally model-sharded.
     """
-    grad_fn = engine.make_grad_fn(model, loss)
     metric_names = tuple(metrics)
+    accum_steps = int(accum_steps)
+    if accum_steps > 1:
+        grad_fn = engine.make_accum_grad_fn(model, loss, accum_steps,
+                                            metric_names)
+    else:
+        grad_fn = engine.make_grad_fn(model, loss)
     base_key = jax.random.key(dropout_seed)
 
     def epoch(state, data, step_offset):
         def one_step(st, xs):
             batch, i = xs
             rng = jax.random.fold_in(base_key, step_offset + i)
-            (loss_val, logits), grads = grad_fn(st.params, batch,
-                                                {"dropout": rng})
+            (loss_val, aux), grads = grad_fn(st.params, batch,
+                                             {"dropout": rng})
             updates, opt_state = tx.update(grads, st.opt_state, st.params)
             params = optax.apply_updates(st.params, updates)
             out = {"loss": loss_val}
             for name in metric_names:
-                out[name] = engine.compute_metric(name, logits,
-                                                  batch["labels"])
+                if accum_steps > 1:
+                    out[name] = engine.finalize_metric(aux[name])
+                else:
+                    out[name] = engine.compute_metric(name, aux,
+                                                      batch["labels"])
             return engine.TrainState(step=st.step + 1, params=params,
                                      opt_state=opt_state), out
 

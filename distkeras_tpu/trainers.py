@@ -34,6 +34,7 @@ from distkeras_tpu.telemetry import span
 from distkeras_tpu.ops import losses as losses_lib
 from distkeras_tpu.ops import optimizers as opt_lib
 from distkeras_tpu.utils.fetch import device_get_batched
+from distkeras_tpu.utils import jax_compat
 
 
 class Trainer:
@@ -129,11 +130,20 @@ class Trainer:
 
     # -- bookkeeping (record_training_time parity) -------------------------
     def _start(self):
+        # opt-in persistent XLA compilation cache: no-op unless the user
+        # called distkeras_tpu.enable_compilation_cache(...) or exported
+        # DISTKERAS_TPU_COMPILE_CACHE (see utils/jax_compat.py)
+        jax_compat.enable_compilation_cache()
         self._t0 = time.perf_counter()
 
     def _stop(self):
         self.training_time = time.perf_counter() - self._t0
         telemetry.gauge("trainer.training_time_s").set(self.training_time)
+        # refresh the HBM gauges (peak over the run lives in the allocator's
+        # peak_bytes_in_use counter); no-op on backends without memory_stats
+        from distkeras_tpu import observability
+
+        observability.hbm_stats()
         if self.telemetry_path is not None:
             self.dump_telemetry(self.telemetry_path)
 
@@ -287,6 +297,7 @@ class DistributedTrainer(Trainer):
                  codec: str = "raw",
                  comms_overlap: bool = False,
                  health=None,
+                 accum_steps: int = 1,
                  **strategy_kwargs):
         super().__init__(model, loss, worker_optimizer, learning_rate,
                          metrics, features_col, label_col, batch_size,
@@ -391,6 +402,20 @@ class DistributedTrainer(Trainer):
         from distkeras_tpu import health as health_lib
 
         self.health = health_lib.resolve(health)
+        # gradient-accumulation microbatching (DESIGN.md §10): each of the
+        # λ local steps scans accum_steps microbatches of batch_size /
+        # accum_steps rows. Same numbers (NUMERICS.md: mean-loss equivalence),
+        # ~accum_steps x smaller activation footprint; λ/window accounting
+        # and the staleness schedule are untouched.
+        self.accum_steps = int(accum_steps)
+        if self.accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        if self.batch_size % self.accum_steps != 0:
+            raise ValueError(
+                f"accum_steps={self.accum_steps} must divide "
+                f"batch_size={self.batch_size}: each step is a scan over "
+                f"accum_steps equal microbatches (unequal microbatches would "
+                f"break the mean-loss equivalence — see NUMERICS.md)")
         self.num_updates = 0
         self.staleness_history: list[float] = []
 
@@ -619,7 +644,7 @@ class DistributedTrainer(Trainer):
                 self._epoch_fn = substrate.build_epoch_fn(
                     self.model, self.loss, self.tx, self.strategy, self.mesh,
                     self.num_workers, self.communication_window, self.metrics,
-                    dropout_seed=self.seed)
+                    dropout_seed=self.seed, accum_steps=self.accum_steps)
         epoch_fn = self._epoch_fn
         self.history = []
         self.staleness_history = []
@@ -843,7 +868,8 @@ class DistributedTrainer(Trainer):
                     self.model, self.loss, self.tx, self.strategy,
                     self.communication_window, self.metrics, self.seed,
                     devices=self.devices or jax.local_devices(),
-                    codec=self.codec, overlap=self.comms_overlap)
+                    codec=self.codec, overlap=self.comms_overlap,
+                    accum_steps=self.accum_steps)
         runner = self._async_runner
         watchdog = None
         if self.health is not None:
@@ -1007,7 +1033,8 @@ class PjitTrainer(Trainer):
                  checkpoint_dir: Optional[str] = None,
                  staging_steps: Optional[int] = None,
                  data_layout: str = "replicated",
-                 telemetry_path: Optional[str] = None):
+                 telemetry_path: Optional[str] = None,
+                 accum_steps: int = 1):
         super().__init__(model, loss, worker_optimizer, learning_rate,
                          metrics, features_col, label_col, batch_size,
                          num_epoch, seed, loss_weights=loss_weights,
@@ -1039,6 +1066,19 @@ class PjitTrainer(Trainer):
                 f"batch_size {self.batch_size} must be divisible by "
                 f"num_workers {self.num_workers} (the batch is the GLOBAL "
                 f"batch, sharded over the workers axis)")
+        # gradient-accumulation microbatching (DESIGN.md §10). Each
+        # microbatch must still shard evenly over the workers axis, so the
+        # PER-DEVICE batch is what accum_steps has to divide.
+        self.accum_steps = int(accum_steps)
+        if self.accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        if (self.batch_size // self.num_workers) % self.accum_steps != 0:
+            raise ValueError(
+                f"accum_steps={self.accum_steps} must divide the per-device "
+                f"batch {self.batch_size // self.num_workers} "
+                f"(global batch_size {self.batch_size} / num_workers "
+                f"{self.num_workers}) so each microbatch shards evenly over "
+                f"the workers axis")
 
     def train(self, dataset: Dataset, shuffle: bool = False,
               resume: bool = False):
@@ -1085,7 +1125,8 @@ class PjitTrainer(Trainer):
             with span("trainer.compile"):
                 self._pjit_fns = tensor.build_pjit_epoch_fn(
                     self.model, self.loss, self.tx, self.mesh, self.metrics,
-                    self.partition_rules, dropout_seed=self.seed)
+                    self.partition_rules, dropout_seed=self.seed,
+                    accum_steps=self.accum_steps)
         epoch_fn, place_state, place_data = self._pjit_fns
         if positions is not None:
             data_sharding = NamedSharding(
@@ -1153,9 +1194,19 @@ class SingleTrainer(Trainer):
     the dataset doesn't fit in HBM.
     """
 
-    def __init__(self, *args, staging_steps: Optional[int] = None, **kwargs):
+    def __init__(self, *args, staging_steps: Optional[int] = None,
+                 accum_steps: int = 1, **kwargs):
         super().__init__(*args, **kwargs)
         self.staging_steps = staging_steps
+        # gradient-accumulation microbatching (DESIGN.md §10)
+        self.accum_steps = int(accum_steps)
+        if self.accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        if self.batch_size % self.accum_steps != 0:
+            raise ValueError(
+                f"accum_steps={self.accum_steps} must divide "
+                f"batch_size={self.batch_size}: each step is a scan over "
+                f"accum_steps equal microbatches")
 
     def train(self, dataset: Dataset, shuffle: bool = False,
               resume: bool = False):
@@ -1180,7 +1231,7 @@ class SingleTrainer(Trainer):
             with span("trainer.compile"):
                 self._epoch_fn = engine.make_epoch_fn(
                     self.model, self.loss, self.tx, metrics=self.metrics,
-                    dropout_seed=self.seed)
+                    dropout_seed=self.seed, accum_steps=self.accum_steps)
         epoch_fn = self._epoch_fn
         staged = None
         device_history = []  # device arrays; fetched once at the end

@@ -22,4 +22,47 @@ else:
             check_rep=check_vma, **kwargs)
 
 
-__all__ = ["shard_map"]
+_CACHE_ENV_VAR = "DISTKERAS_TPU_COMPILE_CACHE"
+_cache_dir: str | None = None
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Opt into jax's persistent compilation cache.
+
+    Big-model XLA compiles run minutes; the remat x accumulation sweep in
+    benchmarks/step_probe.py recompiles the same step for every config. A
+    persistent on-disk cache turns every repeat compile (re-runs, warm
+    restarts, the other configs of a sweep that share an executable) into a
+    disk read.
+
+    ``cache_dir`` defaults to ``$DISTKERAS_TPU_COMPILE_CACHE``; with neither
+    set this is a no-op returning None (the cache stays opt-in — a surprise
+    cache directory in CI or a read-only container would be worse than slow
+    compiles). Safe to call repeatedly and on jax releases without the
+    config knob (guarded no-op). Returns the active cache dir or None.
+    """
+    global _cache_dir
+    import os
+
+    if cache_dir is None:
+        cache_dir = os.environ.get(_CACHE_ENV_VAR) or None
+    if cache_dir is None:
+        return _cache_dir
+    try:
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        # cache everything, including sub-second CPU test compiles — the
+        # default min-entry-size/min-compile-time heuristics are tuned for
+        # TPU pods and would skip exactly the compiles local runs repeat
+        for knob, val in (("jax_persistent_cache_min_entry_size_bytes", -1),
+                          ("jax_persistent_cache_min_compile_time_secs", 0.0)):
+            try:
+                jax.config.update(knob, val)
+            except (AttributeError, ValueError):
+                pass  # knob not in this release; dir alone still caches
+    except (AttributeError, ValueError):
+        return None  # release without the cache config: guarded no-op
+    _cache_dir = str(cache_dir)
+    return _cache_dir
+
+
+__all__ = ["shard_map", "enable_compilation_cache"]

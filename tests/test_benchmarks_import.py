@@ -20,7 +20,7 @@ PKG_MODULES = sorted(
 
 def test_discovery_found_the_tools():
     # the floor protects against the glob silently matching nothing
-    assert len(SCRIPTS) >= 8, SCRIPTS
+    assert len(SCRIPTS) >= 9, SCRIPTS
     assert "distkeras_tpu.benchmarks.run_config" in PKG_MODULES
     # the serving load generator (ISSUE 2) must be under the smoke glob
     assert any(os.path.basename(p) == "serving_load.py" for p in SCRIPTS)
@@ -28,6 +28,20 @@ def test_discovery_found_the_tools():
     assert any(os.path.basename(p) == "comms_bench.py" for p in SCRIPTS)
     # the live health-plane probe (ISSUE 4) too
     assert any(os.path.basename(p) == "health_probe.py" for p in SCRIPTS)
+    # the memory-for-compute sweep (ISSUE 5) rides step_probe
+    assert any(os.path.basename(p) == "step_probe.py" for p in SCRIPTS)
+
+
+def test_step_probe_exposes_sweep_api():
+    """The accum x remat sweep (ISSUE 5) must stay addressable: sweep mode
+    in the CLI and the sweep_probe/largest_batch entry points."""
+    path = os.path.join(REPO, "benchmarks", "step_probe.py")
+    spec = importlib.util.spec_from_file_location("step_probe_sweep", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert callable(mod.sweep_probe)
+    assert callable(mod.largest_batch)
+    assert callable(mod.build_family)
 
 
 @pytest.mark.parametrize("path", SCRIPTS,

@@ -103,3 +103,117 @@ def test_sown_aux_losses_fold_into_objective():
     np.testing.assert_allclose(float(total), float(base) + 0.25, rtol=1e-6)
     np.testing.assert_allclose(np.asarray(logits), np.asarray(base_logits),
                                rtol=1e-6)
+
+
+# -- gradient accumulation (DESIGN.md §10, NUMERICS.md equivalence note) ----
+
+def test_accum_grad_matches_full_batch():
+    """accum grads on k microbatches == full-batch mean-loss grads."""
+    model = MLP(features=(16,), num_classes=4)
+    batch = _batch(n=24)
+    params = model.init(jax.random.key(0), batch["features"])["params"]
+    full = engine.make_grad_fn(model, "categorical_crossentropy")
+    accum = engine.make_accum_grad_fn(model, "categorical_crossentropy", 4)
+    (l0, logits), g0 = full(params, batch)
+    (l1, terms), g1 = accum(params, batch)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    assert terms == {}  # no metric names requested
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_accum_train_step_golden_parity():
+    """The golden guarantee: accum_steps=k on k·m rows equals the full-batch
+    step — same params trajectory, same loss/metrics, and the SAME optimizer
+    state treedef (accumulation must not restructure optax state)."""
+    model = MLP(features=(16,), num_classes=4)
+    batch = _batch(n=32)
+    tx = optax.adam(1e-2)
+    s_full = engine.create_train_state(model, jax.random.key(0), batch, tx)
+    s_acc = engine.create_train_state(model, jax.random.key(0), batch, tx)
+    step_full = engine.make_train_step(model, "categorical_crossentropy", tx,
+                                       metrics=("accuracy",))
+    step_acc = engine.make_train_step(model, "categorical_crossentropy", tx,
+                                      metrics=("accuracy",), accum_steps=4)
+    for _ in range(5):
+        s_full, m_full = step_full(s_full, batch)
+        s_acc, m_acc = step_acc(s_acc, batch)
+        np.testing.assert_allclose(float(m_full["loss"]),
+                                   float(m_acc["loss"]), rtol=1e-5)
+        np.testing.assert_allclose(float(m_full["accuracy"]),
+                                   float(m_acc["accuracy"]), rtol=1e-6)
+    assert (jax.tree.structure(s_full.opt_state)
+            == jax.tree.structure(s_acc.opt_state))
+    assert int(s_acc.step) == 5  # optimizer steps, not microbatches
+    for a, b in zip(jax.tree.leaves(s_full.params),
+                    jax.tree.leaves(s_acc.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_accum_metric_terms_masked_accuracy():
+    """Masked accuracy must accumulate as sum(hits)/sum(valid) — a mean of
+    per-microbatch ratios is wrong when microbatches carry different
+    valid-position counts."""
+    # microbatch 1: 1 valid position, 1 hit; microbatch 2: 2 valid, 1 hit
+    # -> true accuracy 2/3; mean of per-micro ratios (1.0 + 0.5)/2 = 0.75
+    logits = jnp.array([[[2.0, 0.0], [2.0, 0.0]],
+                        [[2.0, 0.0], [0.0, 2.0]]])  # [2 micro, 2 pos, 2 cls]
+    labels = jnp.array([[0, -1], [1, 1]])
+    terms = [engine.compute_metric_terms("accuracy", logits[i], labels[i])
+             for i in range(2)]
+    num = sum(t[0] for t in terms)
+    den = sum(t[1] for t in terms)
+    acc = float(engine.finalize_metric((num, den)))
+    np.testing.assert_allclose(acc, 2.0 / 3.0, rtol=1e-6)
+    ratio_mean = float(np.mean([float(engine.finalize_metric(t))
+                                for t in terms]))
+    assert abs(acc - ratio_mean) > 0.05  # the two aggregations truly differ
+    full = float(engine.compute_metric("accuracy", logits.reshape(4, 2),
+                                       labels.reshape(4)))
+    np.testing.assert_allclose(acc, full)
+
+
+def test_finalize_metric_all_masked_is_zero_not_nan():
+    assert float(engine.finalize_metric(
+        (jnp.float32(0.0), jnp.float32(0.0)))) == 0.0
+
+
+def test_accum_validation_errors():
+    model = MLP(features=(8,), num_classes=4)
+    with pytest.raises(ValueError, match="accum_steps must be >= 1"):
+        engine.make_accum_grad_fn(model, "mse", 0)
+    grad_fn = engine.make_accum_grad_fn(model, "categorical_crossentropy", 5)
+    batch = _batch(n=16)
+    params = model.init(jax.random.key(0), batch["features"])["params"]
+    with pytest.raises(ValueError, match="must divide the per-step batch"):
+        grad_fn(params, batch)
+
+
+def test_accum_epoch_fn_matches_plain_epoch():
+    """make_epoch_fn(accum_steps=k) scans the same data to the same params
+    as accum_steps=1 (mean-loss objective, no dropout)."""
+    model = MLP(features=(16,), num_classes=4)
+    steps, n = 3, 16
+    rng = np.random.default_rng(3)
+    data = {"features": rng.standard_normal((steps, n, 32)).astype(np.float32),
+            "labels": np.eye(4, dtype=np.float32)[
+                rng.integers(0, 4, (steps, n))]}
+    tx = optax.sgd(0.1)
+    sample = {k: v[0] for k, v in data.items()}
+    s1 = engine.create_train_state(model, jax.random.key(0), sample, tx)
+    s2 = engine.create_train_state(model, jax.random.key(0), sample, tx)
+    e1 = engine.make_epoch_fn(model, "categorical_crossentropy", tx,
+                              metrics=("accuracy",))
+    e2 = engine.make_epoch_fn(model, "categorical_crossentropy", tx,
+                              metrics=("accuracy",), accum_steps=2)
+    s1, m1 = e1(s1, data)
+    s2, m2 = e2(s2, data)
+    np.testing.assert_allclose(np.asarray(m1["loss"]), np.asarray(m2["loss"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(m1["accuracy"]),
+                               np.asarray(m2["accuracy"]), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)

@@ -264,3 +264,41 @@ def test_concat_of_lazy_datasets_stays_lazy(shard_files):
     # eager inputs still concatenate eagerly
     mem = Dataset.concat([ds.take(8), ds.take(8)])
     assert isinstance(mem["features"], np.ndarray)
+
+
+def test_prefetch_puts_counter_and_quiet_wait_histogram():
+    """Every successful put bumps data.prefetch.puts; the producer-wait
+    histogram must NOT record the uncontended fast path (it used to log a
+    ~0s sample per put, dragging the reported backpressure toward zero)."""
+    from distkeras_tpu import telemetry
+
+    reg = telemetry.reset()
+    try:
+        assert list(prefetch(iter(range(7)), depth=8)) == list(range(7))
+        snap = reg.snapshot()
+        # 7 items + the DONE sentinel; a slow consumer never blocks these
+        # puts because depth exceeds the item count
+        assert snap["counters"]["data.prefetch.puts"] == 8
+        wait = snap["histograms"].get("data.prefetch.producer_wait_s")
+        assert wait is None or wait["count"] == 0, wait
+    finally:
+        telemetry.reset()
+
+
+def test_prefetch_wait_histogram_records_real_backpressure():
+    """A consumer slower than the producer fills the depth-1 queue; those
+    blocked puts must land in the histogram."""
+    import time as _time
+
+    from distkeras_tpu import telemetry
+
+    reg = telemetry.reset()
+    try:
+        for item in prefetch(iter(range(4)), depth=1):
+            _time.sleep(0.25)  # > the producer's 0.1s poll interval
+        snap = reg.snapshot()
+        wait = snap["histograms"]["data.prefetch.producer_wait_s"]
+        assert wait["count"] >= 1, wait
+        assert snap["counters"]["data.prefetch.puts"] == 5
+    finally:
+        telemetry.reset()

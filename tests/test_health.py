@@ -592,3 +592,18 @@ def test_trainer_rejects_prebuilt_watchdog():
     with pytest.raises(TypeError, match="fresh watchdog"):
         DOWNPOUR(MLP(features=(8,)), mode="host_async", num_workers=2,
                  health=TrainingWatchdog())
+
+
+def test_status_digest_merges_hbm_gauges():
+    """The HBM numbers reach the status op through observability.hbm_*
+    gauges in the registry snapshot — the jax-free route (the no-jax source
+    rule above forbids this module reading device.memory_stats itself)."""
+    status = endpoints.handle_health_op("status", {})
+    assert "hbm" not in status  # no gauges published -> no phantom key
+    telemetry.gauge("observability.hbm_peak_bytes").set(2.0e9)
+    telemetry.gauge("observability.hbm_allocated_bytes").set(1.5e9)
+    telemetry.gauge("observability.hbm_limit_bytes").set(16.0e9)
+    status = endpoints.handle_health_op("status", {})
+    assert status["hbm"] == {"peak_bytes": 2_000_000_000,
+                             "allocated_bytes": 1_500_000_000,
+                             "limit_bytes": 16_000_000_000}

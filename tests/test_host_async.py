@@ -328,3 +328,27 @@ def test_checkpoint_cadence_concurrent_workers_fire_once():
     for t in ts:
         t.join()
     assert sorted(fires) == sorted(set(fires))  # no double-fire anywhere
+
+
+def test_host_async_accum_steps_window_accounting_unchanged():
+    """Gradient accumulation happens INSIDE each local step's grad fn, so a
+    window is still λ optimizer steps and one commit: commit counts and the
+    staleness histogram length must be identical with and without it."""
+    ds = synthetic_mnist(n=1024)
+
+    def run(accum):
+        t = DOWNPOUR(_model(), mode="host_async", num_workers=4,
+                     worker_optimizer="sgd", learning_rate=0.05,
+                     batch_size=32, communication_window=4, num_epoch=2,
+                     accum_steps=accum)
+        t.train(ds)
+        return t
+
+    t1, t4 = run(1), run(4)
+    expected = 4 * (1024 // 4 // (32 * 4)) * 2  # workers x rounds x epochs
+    assert t1.num_updates == expected
+    assert t4.num_updates == expected
+    assert len(t4.staleness_history) == len(t1.staleness_history) == expected
+    assert np.all(np.isfinite([h["loss"] for h in t4.get_history()]))
+    # history length too: metrics stay per optimizer step, not per microbatch
+    assert len(t4.get_history()) == len(t1.get_history())

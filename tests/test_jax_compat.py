@@ -1,0 +1,57 @@
+"""Compat-shim tests: the persistent compilation cache opt-in (DESIGN.md §10).
+
+The cache is process-global jax config, so every test restores the prior
+state — leaking a cache dir into the rest of the suite would silently
+change what tier-1 measures.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distkeras_tpu.utils import jax_compat
+
+
+@pytest.fixture
+def clean_cache_state(monkeypatch, tmp_path):
+    """Fresh module state + env, and jax config restored afterwards."""
+    monkeypatch.delenv(jax_compat._CACHE_ENV_VAR, raising=False)
+    monkeypatch.setattr(jax_compat, "_cache_dir", None)
+    yield tmp_path
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+    except (AttributeError, ValueError):
+        pass
+
+
+def test_cache_is_noop_without_optin(clean_cache_state):
+    """No arg, no env var -> None, and jax config untouched."""
+    assert jax_compat.enable_compilation_cache() is None
+    assert jax.config.jax_compilation_cache_dir in (None, "")
+
+
+def test_cache_explicit_dir_writes_entries(clean_cache_state):
+    cache_dir = str(clean_cache_state / "xla")
+    assert jax_compat.enable_compilation_cache(cache_dir) == cache_dir
+    # a fresh compile (unique constant -> unique cache key) must land on disk
+    x = jnp.ones((8, 8)) * 1.2345678
+    jax.jit(lambda a: (a @ a) + 0.987654)(x).block_until_ready()
+    entries = [f for root, _, files in os.walk(cache_dir) for f in files]
+    assert entries, "compilation cache dir stayed empty after a jit compile"
+
+
+def test_cache_env_var_fallback(clean_cache_state, monkeypatch):
+    cache_dir = str(clean_cache_state / "from_env")
+    monkeypatch.setenv(jax_compat._CACHE_ENV_VAR, cache_dir)
+    assert jax_compat.enable_compilation_cache() == cache_dir
+    # repeat calls without an arg report the active dir, not None
+    assert jax_compat.enable_compilation_cache() == cache_dir
+
+
+def test_cache_exported_at_package_top_level():
+    import distkeras_tpu
+
+    assert distkeras_tpu.enable_compilation_cache \
+        is jax_compat.enable_compilation_cache

@@ -119,3 +119,60 @@ def test_pallas_call_flops_scale_with_grid():
     x = jnp.ones((128, 128), jnp.float32)
     flops = observability.count_flops(f, x, x)
     assert flops == 4 * 2 * 128 ** 3  # grid cells x 2*MACs per cell
+
+
+def test_hbm_stats_cpu_returns_none_without_phantom_gauges():
+    """CPU has no PJRT allocator stats: hbm_stats must return None AND not
+    publish stale observability.hbm_* gauges for the health digest."""
+    from distkeras_tpu import telemetry
+
+    reg = telemetry.reset()
+    try:
+        assert obs.hbm_stats() is None
+        gauges = reg.snapshot().get("gauges", {})
+        assert not any(k.startswith("observability.hbm_") for k in gauges)
+    finally:
+        telemetry.reset()
+
+
+def test_hbm_stats_publishes_gauges_with_fake_device():
+    from distkeras_tpu import telemetry
+
+    class FakeDevice:
+        def memory_stats(self):
+            return {"peak_bytes_in_use": 2048, "bytes_in_use": 1024,
+                    "bytes_limit": 4096}
+
+    reg = telemetry.reset()
+    try:
+        out = obs.hbm_stats(FakeDevice())
+        assert out == {"peak_bytes": 2048, "allocated_bytes": 1024,
+                       "limit_bytes": 4096}
+        gauges = reg.snapshot()["gauges"]
+        assert gauges["observability.hbm_peak_bytes"] == 2048.0
+        assert gauges["observability.hbm_allocated_bytes"] == 1024.0
+        assert gauges["observability.hbm_limit_bytes"] == 4096.0
+    finally:
+        telemetry.reset()
+
+
+def test_compiled_memory_bytes_reports_temp_scratch():
+    """memory_analysis works on CPU — the remat acceptance tests lean on
+    temp_bytes, so its plumbing is guarded here."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        return jnp.sum(jnp.tanh(x @ x.T) @ x)
+
+    compiled = jax.jit(f).lower(jnp.ones((64, 64))).compile()
+    mem = obs.compiled_memory_bytes(compiled)
+    assert mem is not None
+    assert mem["temp_bytes"] > 0
+    assert mem["argument_bytes"] >= 64 * 64 * 4
+    assert set(mem) == {"temp_bytes", "argument_bytes", "output_bytes",
+                        "generated_code_bytes"}
+
+
+def test_compiled_memory_bytes_bad_object_is_none():
+    assert obs.compiled_memory_bytes(object()) is None
