@@ -17,11 +17,32 @@ observability.calibrate_peak). When --batch/--steps are not given, each
 family uses its CANONICAL settings (the ones its BASELINE.md floor is
 defined at — e.g. resnet needs batch 128, gpt OOMs above batch 8).
 
-``sweep`` mode is the memory-for-compute matrix (DESIGN.md §10): one JSON
-line per (model, accum_steps, remat) config with samples/s, XLA's static
+``sweep`` mode is the memory-for-compute matrix (DESIGN.md §10) crossed
+with the low-precision axis (DESIGN.md §11): one JSON line per (model,
+accum_steps, remat, precision) config with samples/s, XLA's static
 peak-scratch bytes (``memory_analysis`` — works on every backend), live
 peak HBM (``device.memory_stats`` — TPU only), and with --find-max-batch a
 doubling search for the largest batch each config can compile and run.
+With ``--buckets`` the sweep instead probes gradient-bucket collective
+overlap: one row per (precision, bucket_bytes) timing the sync-DP epoch
+step over all local devices, where ``none`` is the GSPMD baseline
+(implicit grad all-reduce) and each byte size is the explicit shard_map
+step with per-bucket psums (parallel/collectives.py).
+
+JSONL row schema (absent keys were not measurable on this backend; a
+config that raises emits an ``error`` row instead and the process exits
+nonzero — OOMs are REPORTED, never crashes):
+
+- all rows: ``model``, ``batch``, ``steps_per_call``, ``samples_per_sec``
+- probe rows: ``mfu`` (TPU only; analytic FLOPs / dtype-aware peak)
+- sweep rows: ``accum_steps``, ``remat``, ``precision`` (null = model
+  default), ``mfu_dtype`` (which peak column an MFU claim is honest
+  against), ``temp_bytes`` (XLA static scratch), ``hbm_*`` (TPU only),
+  ``mfu`` (TPU only)
+- --find-max-batch rows: ``largest_batch``, ``search_limit``
+- --buckets rows: ``mode`` ("gspmd" | "bucketed"), ``bucket_bytes``
+  (null for gspmd), ``num_workers``, ``precision``
+- error rows: the swept axes + ``error`` ("ExcType: message")
 """
 
 from __future__ import annotations
@@ -41,30 +62,34 @@ except ImportError:  # running from a source checkout: use the repo root
         os.path.abspath(__file__))))
 
 
-def build_family(name: str, batch: int, remat: str = "none") -> tuple:
+def build_family(name: str, batch: int, remat: str = "none",
+                 precision: str = None) -> tuple:
     """(model, loss, x, y) for one probe family; ``remat`` is threaded to
     the model's rematerialization field (models/remat.py) where the family
-    has one (cnn has no block structure to checkpoint)."""
+    has one (cnn has no block structure to checkpoint), ``precision`` to
+    its mixed-precision field (distkeras_tpu/precision.py)."""
     import jax.numpy as jnp
 
     if name == "vit":
         from distkeras_tpu.models import vit_base
 
-        model, loss = vit_base(remat=remat), "categorical_crossentropy"
+        model = vit_base(remat=remat, precision=precision)
+        loss = "categorical_crossentropy"
         rng = np.random.default_rng(0)
         x = rng.integers(0, 256, (batch, 224, 224, 3), dtype=np.uint8)
         y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]
     elif name == "resnet":
         from distkeras_tpu.models import resnet50_nf
 
-        model, loss = resnet50_nf(remat=remat), "categorical_crossentropy"
+        model = resnet50_nf(remat=remat, precision=precision)
+        loss = "categorical_crossentropy"
         rng = np.random.default_rng(0)
         x = rng.integers(0, 256, (batch, 224, 224, 3), dtype=np.uint8)
         y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]
     elif name == "bert":
         from distkeras_tpu.models import bert_base
 
-        model, loss = bert_base(remat=remat), "masked_lm"
+        model, loss = bert_base(remat=remat, precision=precision), "masked_lm"
         rng = np.random.default_rng(0)
         x = rng.integers(1, model.vocab_size, (batch, 128)).astype(np.int16)
         y = np.where(rng.random((batch, 128)) < 0.15, x, -1).astype(np.int16)
@@ -75,7 +100,7 @@ def build_family(name: str, batch: int, remat: str = "none") -> tuple:
 
         if remat != "none":
             raise ValueError("cnn has no block structure to rematerialize")
-        model, loss = (cifar10_cnn(dtype=jnp.bfloat16),
+        model, loss = (cifar10_cnn(dtype=jnp.bfloat16, precision=precision),
                        "categorical_crossentropy")
         rng = np.random.default_rng(0)
         x = rng.standard_normal((batch, 32, 32, 3)).astype(np.float32)
@@ -88,7 +113,8 @@ def build_family(name: str, batch: int, remat: str = "none") -> tuple:
 
         model = CausalLM(vocab_size=50304, max_len=2048, num_layers=12,
                          num_heads=12, width=768, mlp_dim=3072,
-                         attention="flash", remat=remat)
+                         attention="flash", remat=remat,
+                         precision=precision)
         loss = "masked_lm"
         rng = np.random.default_rng(0)
         x = rng.integers(1, model.vocab_size, (batch, 2048)).astype(np.int32)
@@ -162,8 +188,9 @@ def _is_oom(e: BaseException) -> bool:
 
 
 def sweep_probe(name: str, batch: int, steps: int, accum_steps: int,
-                remat: str, compile_only: bool = False) -> dict:
-    """One (model, accum, remat) cell of the memory-for-compute matrix.
+                remat: str, compile_only: bool = False,
+                precision: str = None) -> dict:
+    """One (model, accum, remat, precision) cell of the sweep matrix.
 
     Reports samples/s (fetch-synced, like :func:`probe`), XLA's static
     peak-scratch bytes from ``memory_analysis`` (every backend — the
@@ -171,22 +198,34 @@ def sweep_probe(name: str, batch: int, steps: int, accum_steps: int,
     (TPU only). ``compile_only`` stops after compilation + the memory
     numbers — the largest-batch search uses it so each doubling costs one
     compile, not a timed run.
+
+    ``precision`` stamps the model's mixed-precision field and mirrors the
+    trainer step exactly: a loss-scaling policy gets the overflow-guarded
+    optimizer and the step reads the live scale out of ``opt_state``; the
+    reported MFU is measured against that policy's honest peak column
+    (``mfu_dtype`` in the row).
     """
     import jax
     import jax.numpy as jnp
     import optax
 
     from distkeras_tpu import engine, observability
+    from distkeras_tpu import precision as precision_lib
 
     if batch % accum_steps:
         raise ValueError(f"accum_steps={accum_steps} must divide "
                          f"batch={batch}")
-    model, loss, x, y = build_family(name, batch, remat=remat)
+    model, loss, x, y = build_family(name, batch, remat=remat,
+                                     precision=precision)
+    policy = precision_lib.get_policy(precision)
     tx = optax.adamw(1e-3)
+    if policy is not None and policy.loss_scale != 1.0:
+        tx = precision_lib.overflow_guard(tx, policy)
     if accum_steps > 1:
-        grad_fn = engine.make_accum_grad_fn(model, loss, accum_steps)
+        grad_fn = engine.make_accum_grad_fn(model, loss, accum_steps,
+                                            precision=precision)
     else:
-        grad_fn = engine.make_grad_fn(model, loss)
+        grad_fn = engine.make_grad_fn(model, loss, precision=precision)
     xd, yd = jnp.asarray(x), jnp.asarray(y)
     state = engine.create_train_state(model, jax.random.key(0),
                                       {"features": xd}, tx)
@@ -195,7 +234,8 @@ def sweep_probe(name: str, batch: int, steps: int, accum_steps: int,
     def run(params, opt_state, x, y):
         def one(c, _):
             p, o = c
-            (l, _), g = grad_fn(p, {"features": x, "labels": y}, None)
+            (l, _), g = grad_fn(p, {"features": x, "labels": y}, None,
+                                loss_scale=precision_lib.current_scale(o))
             up, o = tx.update(g, o, p)
             return (optax.apply_updates(p, up), o), l
 
@@ -203,8 +243,10 @@ def sweep_probe(name: str, batch: int, steps: int, accum_steps: int,
                                   length=steps)
         return p, o, jnp.sum(ls)
 
+    mfu_dtype = policy.mfu_dtype if policy is not None else "bf16"
     out = {"model": name, "batch": batch, "accum_steps": accum_steps,
-           "remat": remat, "steps_per_call": steps}
+           "remat": remat, "precision": precision,
+           "mfu_dtype": mfu_dtype, "steps_per_call": steps}
     compiled = run.lower(state.params, state.opt_state, xd, yd).compile()
     mem = observability.compiled_memory_bytes(compiled)
     if mem:
@@ -221,6 +263,12 @@ def sweep_probe(name: str, batch: int, steps: int, accum_steps: int,
         times.append(time.perf_counter() - t0)
     dt = sorted(times)[1]
     out["samples_per_sec"] = round(batch * steps / dt, 1)
+    peak = observability.device_peak_flops(dtype=mfu_dtype)
+    if peak:
+        flops = observability.count_flops(
+            lambda pp, b: grad_fn(pp, b, None)[1], state.params,
+            {"features": xd, "labels": yd}) * steps
+        out["mfu"] = round(flops / dt / peak, 4)
     hbm = observability.hbm_stats()  # live allocator peak — TPU only
     if hbm:
         out.update({f"hbm_{k}": v for k, v in hbm.items()})
@@ -251,6 +299,63 @@ def largest_batch(name: str, steps: int, accum_steps: int, remat: str,
             "largest_batch": best, "search_limit": limit}
 
 
+def overlap_probe(name: str, batch: int, steps: int,
+                  bucket_bytes, precision: str = None) -> dict:
+    """One bucket-size cell of the gradient-overlap sweep (--buckets).
+
+    Times the sync data-parallel epoch step over ALL local devices:
+    ``bucket_bytes=None`` is the GSPMD baseline (XLA's implicit grad
+    all-reduce), an int is the explicit shard_map step whose grad psums
+    are issued per size-targeted bucket (parallel/collectives.py) so the
+    collectives overlap backward. The two trajectories are bitwise-equal
+    (tests/test_overlap.py) — only the schedule differs, which is exactly
+    what this probe measures.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distkeras_tpu import engine
+    from distkeras_tpu import precision as precision_lib
+    from distkeras_tpu.parallel import mesh as mesh_lib
+    from distkeras_tpu.parallel import tensor
+
+    mesh = mesh_lib.make_mesh()  # all local devices, pure data-parallel
+    num_workers = mesh.shape[mesh_lib.WORKER_AXIS]
+    if batch % num_workers:
+        raise ValueError(f"batch={batch} must divide over the "
+                         f"{num_workers} local devices")
+    model, loss, x, y = build_family(name, batch, precision=precision)
+    policy = precision_lib.get_policy(precision)
+    tx = optax.adamw(1e-3)
+    if policy is not None and policy.loss_scale != 1.0:
+        tx = precision_lib.overflow_guard(tx, policy)
+    epoch_fn, place_state, place_data = tensor.build_pjit_epoch_fn(
+        model, loss, tx, mesh, precision=precision,
+        bucket_bytes=bucket_bytes)
+    xd = jnp.asarray(x)
+    state = place_state(engine.create_train_state(
+        model, jax.random.key(0), {"features": xd}, tx))
+    data = place_data({
+        "features": np.broadcast_to(x[None], (steps,) + x.shape),
+        "labels": np.broadcast_to(y[None], (steps,) + y.shape)})
+
+    state, ms = epoch_fn(state, data, 0)
+    float(np.asarray(ms["loss"]).sum())  # compile + settle
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        state, ms = epoch_fn(state, data, 0)
+        float(np.asarray(ms["loss"]).sum())
+        times.append(time.perf_counter() - t0)
+    dt = sorted(times)[1]
+    return {"model": name, "batch": batch, "steps_per_call": steps,
+            "mode": "gspmd" if bucket_bytes is None else "bucketed",
+            "bucket_bytes": bucket_bytes, "num_workers": num_workers,
+            "precision": precision,
+            "samples_per_sec": round(batch * steps / dt, 1)}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("which", nargs="?", default="all",
@@ -265,35 +370,65 @@ def main():
                     help="sweep mode: comma-separated accum_steps values")
     ap.add_argument("--remat", default="none,blocks",
                     help="sweep mode: comma-separated remat policies")
+    ap.add_argument("--precision", default="none",
+                    help="sweep mode: comma-separated precision policies "
+                         "(none|f32|bf16|int8|fp8-sim; 'none' = the "
+                         "model's default compute dtype)")
+    ap.add_argument("--buckets", default=None,
+                    help="sweep mode: comma-separated grad-bucket byte "
+                         "sizes ('none' = GSPMD baseline); replaces the "
+                         "accum x remat matrix with the overlap sweep")
     ap.add_argument("--find-max-batch", action="store_true",
                     help="sweep mode: also run the doubling largest-batch "
                          "search per config (accelerator-backed runs)")
     args = ap.parse_args()
+    parse_axis = lambda s: [None if v.strip() in ("none", "") else v.strip()
+                            for v in s.split(",")]
     if args.which == "sweep":
         cfg = dict(CANONICAL[args.model])
         if args.batch is not None:
             cfg["batch"] = args.batch
         if args.steps is not None:
             cfg["steps"] = args.steps
+        precisions = parse_axis(args.precision)
+        failed = False
+        if args.buckets is not None:
+            buckets = [None if b is None else int(b)
+                       for b in parse_axis(args.buckets)]
+            for prec in precisions:
+                for bucket in buckets:
+                    try:
+                        print(json.dumps(overlap_probe(
+                            args.model, cfg["batch"], cfg["steps"],
+                            bucket, precision=prec)), flush=True)
+                    except Exception as e:
+                        failed = True
+                        print(json.dumps(
+                            {"model": args.model, "bucket_bytes": bucket,
+                             "precision": prec,
+                             "error": f"{type(e).__name__}: {e}"}),
+                            flush=True)
+            sys.exit(1 if failed else 0)
         accums = [int(a) for a in args.accum.split(",")]
         remats = [r.strip() for r in args.remat.split(",")]
-        failed = False
         for remat in remats:
             for accum in accums:
-                try:
-                    print(json.dumps(sweep_probe(
-                        args.model, cfg["batch"], cfg["steps"], accum,
-                        remat)), flush=True)
-                    if args.find_max_batch:
-                        print(json.dumps(largest_batch(
-                            args.model, cfg["steps"], accum, remat,
-                            start=cfg["batch"])), flush=True)
-                except Exception as e:
-                    failed = True
-                    print(json.dumps(
-                        {"model": args.model, "accum_steps": accum,
-                         "remat": remat,
-                         "error": f"{type(e).__name__}: {e}"}), flush=True)
+                for prec in precisions:
+                    try:
+                        print(json.dumps(sweep_probe(
+                            args.model, cfg["batch"], cfg["steps"], accum,
+                            remat, precision=prec)), flush=True)
+                        if args.find_max_batch:
+                            print(json.dumps(largest_batch(
+                                args.model, cfg["steps"], accum, remat,
+                                start=cfg["batch"])), flush=True)
+                    except Exception as e:
+                        failed = True
+                        print(json.dumps(
+                            {"model": args.model, "accum_steps": accum,
+                             "remat": remat, "precision": prec,
+                             "error": f"{type(e).__name__}: {e}"}),
+                            flush=True)
         sys.exit(1 if failed else 0)
     names = list(CANONICAL) if args.which == "all" else [args.which]
     for name in names:

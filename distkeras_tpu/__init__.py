@@ -10,6 +10,7 @@ instead of TCP+pickle. See SURVEY.md for the layer-by-layer mapping.
 __version__ = "0.7.0"
 
 from distkeras_tpu import telemetry
+from distkeras_tpu.precision import PRECISION_POLICIES, PrecisionPolicy
 from distkeras_tpu.utils.jax_compat import enable_compilation_cache
 from distkeras_tpu.data.dataset import Dataset, synthetic_mnist
 from distkeras_tpu.evaluators import AccuracyEvaluator, Evaluator, LossEvaluator
@@ -57,8 +58,10 @@ __all__ = [
     "ModelClassifier",
     "ModelPredictor",
     "OneHotTransformer",
+    "PRECISION_POLICIES",
     "Pipeline",
     "PjitTrainer",
+    "PrecisionPolicy",
     "Predictor",
     "ReshapeTransformer",
     "ServingEngine",

@@ -121,6 +121,32 @@ class Bf16Codec(_CastCodec):
         return np.dtype(ml_dtypes.bfloat16)
 
 
+def affine_qparams(lo: float, hi: float, levels: int):
+    """Quantization step for an affine grid of ``levels + 1`` codes spanning
+    ``[lo, hi]``. THE one scale rule shared by the wire codec (lo=min,
+    hi=max, levels=255) and the in-step quantizer (lo=-amax, hi=+amax,
+    levels=254 — symmetric int8; see distkeras_tpu/precision.py), so wire
+    and step numerics cannot silently diverge."""
+    return (hi - lo) / levels
+
+
+def affine_quantize(a, lo, scale, levels, xp=np):
+    """Codes in ``[0, levels]`` for the affine grid ``lo + scale * q``.
+    Branchless (``xp`` may be jax.numpy inside a trace): a zero scale —
+    constant leaf — maps every element to code 0, which dequantizes exactly.
+    Division (not multiply-by-reciprocal) keeps codes bit-identical to the
+    original wire arithmetic."""
+    ok = scale > 0
+    safe = xp.where(ok, scale, xp.ones_like(scale * 1.0))
+    q = xp.clip(xp.rint((a - lo) / safe), 0, levels)
+    return xp.where(ok, q, xp.zeros_like(q))
+
+
+def affine_dequantize(q, lo, scale):
+    """Inverse of affine_quantize: ``lo + scale * q`` (backend-agnostic)."""
+    return lo + scale * q
+
+
 class QuantCodec(Codec):
     """Per-leaf int8 affine quantization for commits; f16 casts for pulls.
 
@@ -145,11 +171,9 @@ class QuantCodec(Codec):
         if a.size == 0:
             return b""
         lo, hi = float(a.min()), float(a.max())
-        scale = (hi - lo) / self._LEVELS
-        if scale > 0.0:
-            q = np.clip(np.rint((a - lo) / scale), 0, self._LEVELS)
-        else:
-            q = np.zeros_like(a)
+        scale = float(affine_qparams(lo, hi, self._LEVELS))
+        q = affine_quantize(a, np.float32(lo), np.float32(scale),
+                            self._LEVELS, xp=np)
         head = np.array([scale, lo], dtype="<f4").tobytes()
         return head + q.astype(np.uint8).tobytes()
 
@@ -167,8 +191,9 @@ class QuantCodec(Codec):
                 f"shape {shape} (want {8 + n})")
         scale, lo = np.frombuffer(blob[:8], dtype="<f4")
         q = np.frombuffer(blob, dtype=np.uint8, offset=8)
-        return (np.float32(lo) + np.float32(scale)
-                * q.astype(np.float32)).reshape(shape).astype(dtype)
+        return affine_dequantize(
+            q.astype(np.float32), np.float32(lo),
+            np.float32(scale)).reshape(shape).astype(dtype)
 
 
 _REGISTRY: Dict[str, Codec] = {

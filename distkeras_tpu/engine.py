@@ -23,6 +23,7 @@ import optax
 from flax import struct
 
 from distkeras_tpu.ops import losses as losses_lib
+from distkeras_tpu import precision as precision_lib
 from distkeras_tpu.utils.trees import global_norm
 
 Batch = dict  # {"features": ..., "labels": ...} plus model-specific keys
@@ -123,7 +124,8 @@ def make_train_step(model, loss, tx: optax.GradientTransformation,
                     with_metrics: bool = True,
                     metrics: tuple = (),
                     dropout_seed: int = 0,
-                    accum_steps: int = 1) -> Callable:
+                    accum_steps: int = 1,
+                    precision=None) -> Callable:
     """Build the jitted single-replica train step.
 
     Returns ``step(state, batch) -> (state, metrics)`` where metrics is a dict
@@ -137,7 +139,8 @@ def make_train_step(model, loss, tx: optax.GradientTransformation,
     the mean-loss objective). The batch's leading dim must be divisible by k.
     """
     one_step = _make_step_body(model, loss, tx, with_metrics, metrics,
-                               dropout_seed, accum_steps)
+                               dropout_seed, accum_steps,
+                               precision=precision)
     return jax.jit(one_step, donate_argnums=(0,))
 
 
@@ -156,7 +159,8 @@ def _split_microbatches(batch: Batch, k: int) -> Batch:
 
 
 def make_accum_grad_fn(model, loss, accum_steps: int,
-                       metric_names: tuple = ()) -> Callable:
+                       metric_names: tuple = (),
+                       precision=None) -> Callable:
     """Gradient-accumulation counterpart of :func:`make_grad_fn`, same
     contract: ``(params, batch, rngs) -> ((loss, aux), grads)`` — so every
     strategy's ``local_step`` composes with it unchanged.
@@ -183,17 +187,35 @@ def make_accum_grad_fn(model, loss, accum_steps: int,
     if k < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     metric_names = tuple(metric_names)
+    policy, scaling = _loss_scaling(precision)
 
-    def grad_fn(params, batch: Batch, rngs: Optional[dict] = None):
+    def grad_fn(params, batch: Batch, rngs: Optional[dict] = None,
+                loss_scale=None):
         micro = _split_microbatches(batch, k)
+        if scaling is None:
+            scale = None
+        else:
+            scale = jnp.float32(policy.loss_scale) if loss_scale is None \
+                else loss_scale
 
         def body(acc, xs):
             batch_i, i = xs
             rngs_i = None if rngs is None else {
                 name: jax.random.fold_in(key, i)
                 for name, key in rngs.items()}
-            (l, logits), g = jax.value_and_grad(compute_loss, has_aux=True)(
-                params, batch_i, rngs_i)
+            if scale is None:
+                (l, logits), g = jax.value_and_grad(
+                    compute_loss, has_aux=True)(params, batch_i, rngs_i)
+            else:
+                # per-microbatch loss scaling; the f32 SUM below is of the
+                # scaled grads — unscaled once after the scan (exact for
+                # power-of-two scales)
+                def scaled(p, b, r):
+                    l, logits = compute_loss(p, b, r)
+                    return scaling[0](l, scale), (l, logits)
+
+                (_, (l, logits)), g = jax.value_and_grad(
+                    scaled, has_aux=True)(params, batch_i, rngs_i)
             terms = {name: compute_metric_terms(name, logits,
                                                 batch_i["labels"])
                      for name in metric_names}
@@ -212,6 +234,8 @@ def make_accum_grad_fn(model, loss, accum_steps: int,
                 zeros_like_f32(params))
         (loss_sum, terms, grad_sum), _ = jax.lax.scan(
             body, init, (micro, jnp.arange(k, dtype=jnp.int32)))
+        if scale is not None:
+            grad_sum = scaling[1](grad_sum, scale)
         grads = jax.tree.map(
             lambda g, p: (g / k).astype(jnp.asarray(p).dtype),
             grad_sum, params)
@@ -222,23 +246,31 @@ def make_accum_grad_fn(model, loss, accum_steps: int,
 
 def _make_step_body(model, loss, tx: optax.GradientTransformation,
                     with_grad_norm: bool, metrics: tuple,
-                    dropout_seed: int, accum_steps: int = 1) -> Callable:
+                    dropout_seed: int, accum_steps: int = 1,
+                    precision=None) -> Callable:
     """The ONE unjitted step body shared by :func:`make_train_step` and
     :func:`make_epoch_fn` — keeping them numerically identical by
     construction, not by hand-synced copies. ``accum_steps > 1`` swaps the
     full-batch grad for the scanned microbatch accumulation
     (:func:`make_accum_grad_fn`); the optimizer still applies once per step,
-    so ``state.step`` counts OPTIMIZER steps either way."""
+    so ``state.step`` counts OPTIMIZER steps either way.
+
+    ``precision=`` threads a loss-scaling policy into the grad fn; when
+    ``tx`` is ``precision.overflow_guard``-wrapped, the LIVE loss scale is
+    read out of the optimizer state (``current_scale``) and fed forward —
+    the dynamic skip-and-rescale loop closes here."""
     metric_names = tuple(metrics)
     base_key = jax.random.key(dropout_seed)
     accum_steps = int(accum_steps)
     if accum_steps > 1:
         accum_grad = make_accum_grad_fn(model, loss, accum_steps,
-                                        metric_names)
+                                        metric_names, precision=precision)
 
         def one_step(state: TrainState, batch: Batch):
             rngs = {"dropout": jax.random.fold_in(base_key, state.step)}
-            (loss_val, terms), grads = accum_grad(state.params, batch, rngs)
+            scale = precision_lib.current_scale(state.opt_state)
+            (loss_val, terms), grads = accum_grad(state.params, batch, rngs,
+                                                  loss_scale=scale)
             updates, opt_state = tx.update(grads, state.opt_state,
                                            state.params)
             params = optax.apply_updates(state.params, updates)
@@ -251,12 +283,13 @@ def _make_step_body(model, loss, tx: optax.GradientTransformation,
                               opt_state=opt_state), out
 
         return one_step
-    compute_loss = make_loss_fn(model, loss)
+    grad_fn = make_grad_fn(model, loss, precision=precision)
 
     def one_step(state: TrainState, batch: Batch):
         rngs = {"dropout": jax.random.fold_in(base_key, state.step)}
-        (loss_val, logits), grads = jax.value_and_grad(
-            compute_loss, has_aux=True)(state.params, batch, rngs)
+        scale = precision_lib.current_scale(state.opt_state)
+        (loss_val, logits), grads = grad_fn(state.params, batch, rngs,
+                                            loss_scale=scale)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         out = {"loss": loss_val}
@@ -272,7 +305,7 @@ def _make_step_body(model, loss, tx: optax.GradientTransformation,
 
 def make_epoch_fn(model, loss, tx: optax.GradientTransformation,
                   metrics: tuple = (), dropout_seed: int = 0,
-                  accum_steps: int = 1) -> Callable:
+                  accum_steps: int = 1, precision=None) -> Callable:
     """Scanned single-replica epoch: the whole staged chunk in ONE device
     call.
 
@@ -285,7 +318,7 @@ def make_epoch_fn(model, loss, tx: optax.GradientTransformation,
     each step (see :func:`make_train_step`).
     """
     one_step = _make_step_body(model, loss, tx, True, metrics, dropout_seed,
-                               accum_steps)
+                               accum_steps, precision=precision)
 
     def epoch(state: TrainState, data: Batch):
         return jax.lax.scan(one_step, state, data)
@@ -293,14 +326,52 @@ def make_epoch_fn(model, loss, tx: optax.GradientTransformation,
     return jax.jit(epoch, donate_argnums=(0,))
 
 
-def make_grad_fn(model, loss) -> Callable:
-    """(params, batch) -> ((loss, logits), grads); building block for the
-    parallel substrate where the optimizer application happens per-strategy."""
-    compute_loss = make_loss_fn(model, loss)
+def _loss_scaling(precision):
+    """(policy, (pre, post)) when the policy actively loss-scales, else
+    (policy, None). f32/bf16 default to scale 1.0 — no scaling code at
+    all, so those paths stay bitwise-identical to precision=None."""
+    policy = precision_lib.get_policy(precision)
+    if policy is None or policy.loss_scale == 1.0:
+        return policy, None
+    return policy, precision_lib.scale_grads_fn(policy)
 
-    def grad_fn(params, batch: Batch, rngs: Optional[dict] = None):
-        return jax.value_and_grad(compute_loss, has_aux=True)(
-            params, batch, rngs)
+
+def make_grad_fn(model, loss, precision=None) -> Callable:
+    """(params, batch) -> ((loss, logits), grads); building block for the
+    parallel substrate where the optimizer application happens per-strategy.
+
+    ``precision=`` (DESIGN.md §11): a quantizing policy scales the loss by
+    the policy's loss scale before ``grad`` and unscales the gradients in
+    f32 after (exact for the power-of-two scales used), guarding low-
+    precision backward passes against underflow-to-zero gradient noise.
+    The reported loss is the UNSCALED one. The optional ``loss_scale``
+    call kwarg lets a step body feed the LIVE scale from an
+    ``overflow_guard``-wrapped optimizer state; strategies that call with
+    three arguments get the policy's static scale — documented asymmetry.
+    """
+    compute_loss = make_loss_fn(model, loss)
+    policy, scaling = _loss_scaling(precision)
+    if scaling is None:
+        def grad_fn(params, batch: Batch, rngs: Optional[dict] = None,
+                    loss_scale=None):
+            return jax.value_and_grad(compute_loss, has_aux=True)(
+                params, batch, rngs)
+
+        return grad_fn
+    pre, post = scaling
+
+    def grad_fn(params, batch: Batch, rngs: Optional[dict] = None,
+                loss_scale=None):
+        scale = jnp.float32(policy.loss_scale) if loss_scale is None \
+            else loss_scale
+
+        def scaled(p, b, r):
+            l, logits = compute_loss(p, b, r)
+            return pre(l, scale), (l, logits)
+
+        (_, (loss_val, logits)), grads = jax.value_and_grad(
+            scaled, has_aux=True)(params, batch, rngs)
+        return (loss_val, logits), post(grads, scale)
 
     return grad_fn
 

@@ -13,9 +13,12 @@ the embedding/logit matmuls), bf16 compute, fp32 LayerNorm/softmax/head.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import flax.linen as nn
 import jax.numpy as jnp
 
+from distkeras_tpu import precision as precision_lib
 from distkeras_tpu.models.transformer import Encoder
 
 
@@ -33,29 +36,36 @@ class BertMLM(nn.Module):
     #: activation rematerialization policy for the encoder blocks
     #: (models/remat.py)
     remat: str = "none"
+    #: mixed-precision policy (distkeras_tpu/precision.py); f32 MLM head
+    #: stays f32
+    precision: Optional[str] = None
 
     @nn.compact
     def __call__(self, input_ids, train: bool = False, segment_ids=None):
+        dtype, dense_kw, _, _ = precision_lib.resolve(self.precision,
+                                                      self.dtype)
         ids = input_ids.astype(jnp.int32)
         b, seq = ids.shape
-        tok = nn.Embed(self.vocab_size, self.width, dtype=self.dtype,
+        tok = nn.Embed(self.vocab_size, self.width, dtype=dtype,
                        name="tok_embed")(ids)
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (self.max_len, self.width))[:seq]
-        x = tok + pos.astype(self.dtype)
+        x = tok + pos.astype(dtype)
         if segment_ids is not None:
-            x = x + nn.Embed(self.num_segments, self.width, dtype=self.dtype,
+            x = x + nn.Embed(self.num_segments, self.width, dtype=dtype,
                              name="seg_embed")(segment_ids.astype(jnp.int32))
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_embed")(x)
-        x = x.astype(self.dtype)
+        x = x.astype(dtype)
 
         mask = ids != self.pad_id  # [b, seq] key-side padding mask
         x = Encoder(self.num_layers, self.num_heads, self.mlp_dim,
                     self.dropout_rate, self.dtype, remat=self.remat,
+                    precision=self.precision,
                     name="encoder")(x, mask=mask, train=train)
 
         # MLM head: transform + tied-style output projection
-        x = nn.Dense(self.width, dtype=self.dtype, name="mlm_dense")(x)
+        x = nn.Dense(self.width, dtype=dtype, name="mlm_dense",
+                     **dense_kw)(x)
         x = nn.gelu(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,

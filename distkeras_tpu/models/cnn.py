@@ -11,11 +11,12 @@ and a float32 head for loss stability.
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Optional, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
 
+from distkeras_tpu import precision as precision_lib
 from distkeras_tpu.models.input_norm import normalize_image_input
 
 
@@ -35,23 +36,29 @@ class CIFARConvNet(nn.Module):
     #: uint8 inputs are normalized on device (models/input_norm.py) —
     #: staging raw bytes is 4x cheaper than f32. No effect on float inputs.
     normalize_uint8: bool = True
+    #: mixed-precision policy (distkeras_tpu/precision.py); overrides
+    #: ``dtype`` for convs and the hidden dense, head stays f32
+    precision: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = normalize_image_input(x, self.dtype, self.normalize_uint8)
+        dtype, dense_kw, conv_kw, _ = precision_lib.resolve(self.precision,
+                                                            self.dtype)
+        x = normalize_image_input(x, dtype, self.normalize_uint8)
         if x.ndim == 2:  # flat feature vectors -> NHWC (reference Reshape path)
             side = int(round((x.shape[-1] // 3) ** 0.5))
             x = x.reshape((x.shape[0], side, side, 3))
         for i, ch in enumerate(self.channels):
-            x = nn.Conv(ch, (3, 3), padding="SAME", dtype=self.dtype,
-                        name=f"conv_{i}a")(x)
+            x = nn.Conv(ch, (3, 3), padding="SAME", dtype=dtype,
+                        name=f"conv_{i}a", **conv_kw)(x)
             x = nn.relu(x)
-            x = nn.Conv(ch, (3, 3), padding="SAME", dtype=self.dtype,
-                        name=f"conv_{i}b")(x)
+            x = nn.Conv(ch, (3, 3), padding="SAME", dtype=dtype,
+                        name=f"conv_{i}b", **conv_kw)(x)
             x = nn.relu(x)
             x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
-        x = nn.Dense(self.dense_width, dtype=self.dtype, name="dense")(x)
+        x = nn.Dense(self.dense_width, dtype=dtype, name="dense",
+                     **dense_kw)(x)
         x = nn.relu(x)
         if self.dropout_rate > 0.0:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)

@@ -19,10 +19,13 @@ single-device and vice versa.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from distkeras_tpu import precision as precision_lib
 from distkeras_tpu.models.remat import remat_wrap
 from distkeras_tpu.models.transformer import MlpBlock
 from distkeras_tpu.ops.attention import dot_product_attention
@@ -34,12 +37,15 @@ class CausalSelfAttention(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     attention: str = "full"  # "full" | "flash" | "ring"
     axis_name: str = "seq"
+    precision: Optional[str] = None
 
     @nn.compact
     def __call__(self, x):
+        dtype, dense_kw, _, _ = precision_lib.resolve(self.precision,
+                                                      self.dtype)
         width = x.shape[-1]
         head_dim = width // self.num_heads
-        qkv = nn.Dense(3 * width, dtype=self.dtype, name="qkv")(x)
+        qkv = nn.Dense(3 * width, dtype=dtype, name="qkv", **dense_kw)(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         split = lambda t: t.reshape(t.shape[:2] + (self.num_heads, head_dim))
         q, k, v = split(q), split(k), split(v)
@@ -57,7 +63,7 @@ class CausalSelfAttention(nn.Module):
                 f"Unknown attention {self.attention!r}; "
                 "expected 'full', 'flash', or 'ring'")
         out = out.reshape(out.shape[:2] + (width,))
-        return nn.Dense(width, dtype=self.dtype, name="out")(out)
+        return nn.Dense(width, dtype=dtype, name="out", **dense_kw)(out)
 
 
 class DecoderBlock(nn.Module):
@@ -66,15 +72,19 @@ class DecoderBlock(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     attention: str = "full"
     axis_name: str = "seq"
+    precision: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(self.dtype)
+        dtype = precision_lib.resolve(self.precision, self.dtype)[0]
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(dtype)
         y = CausalSelfAttention(self.num_heads, self.dtype, self.attention,
-                                self.axis_name, name="attn")(y)
+                                self.axis_name, precision=self.precision,
+                                name="attn")(y)
         x = x + y
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
-        y = MlpBlock(self.mlp_dim, 0.0, self.dtype, name="mlp")(y, train=train)
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(dtype)
+        y = MlpBlock(self.mlp_dim, 0.0, self.dtype,
+                     precision=self.precision, name="mlp")(y, train=train)
         return x + y
 
 
@@ -91,13 +101,17 @@ class CausalLM(nn.Module):
     #: activation rematerialization policy for the decoder blocks
     #: (models/remat.py); "full" also wraps the token embedding.
     remat: str = "none"
+    #: mixed-precision policy (distkeras_tpu/precision.py); f32 LM head
+    #: stays f32
+    precision: Optional[str] = None
 
     @nn.compact
     def __call__(self, input_ids, train: bool = False):
+        dtype = precision_lib.resolve(self.precision, self.dtype)[0]
         ids = input_ids.astype(jnp.int32)
         b, t = ids.shape  # t = LOCAL block length under sequence parallelism
         embed_cls = remat_wrap(nn.Embed, self.remat, stem=True)
-        x = embed_cls(self.vocab_size, self.width, dtype=self.dtype,
+        x = embed_cls(self.vocab_size, self.width, dtype=dtype,
                       name="tok_embed")(ids)
         pos_table = self.param("pos_embed", nn.initializers.normal(0.02),
                                (self.max_len, self.width))
@@ -115,12 +129,13 @@ class CausalLM(nn.Module):
             pos = jax.lax.dynamic_slice_in_dim(pos_table, offset, t)
         else:
             pos = pos_table[:t]
-        x = x + pos.astype(self.dtype)
+        x = x + pos.astype(dtype)
         # positional call, train static at index 2 (models/remat.py rules)
         block_cls = remat_wrap(DecoderBlock, self.remat, static_argnums=(2,))
         for i in range(self.num_layers):
             x = block_cls(self.num_heads, self.mlp_dim, self.dtype,
                           self.attention, self.axis_name,
+                          precision=self.precision,
                           name=f"layer_{i}")(x, train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,

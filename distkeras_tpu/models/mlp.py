@@ -10,10 +10,12 @@ compute dtype is configurable (bfloat16 for TPU, float32 params).
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Optional, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
+
+from distkeras_tpu import precision as precision_lib
 
 
 class MLP(nn.Module):
@@ -21,16 +23,22 @@ class MLP(nn.Module):
     num_classes: int = 10
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
+    #: mixed-precision policy (distkeras_tpu/precision.py); overrides
+    #: ``dtype`` for hidden matmuls, head stays unquantized
+    precision: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        dtype, dense_kw, _, _ = precision_lib.resolve(self.precision,
+                                                      self.dtype)
+        x = x.reshape((x.shape[0], -1)).astype(dtype)
         for i, width in enumerate(self.features):
-            x = nn.Dense(width, dtype=self.dtype, name=f"dense_{i}")(x)
+            x = nn.Dense(width, dtype=dtype, name=f"dense_{i}",
+                         **dense_kw)(x)
             x = nn.relu(x)
             if self.dropout_rate > 0.0:
                 x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        x = nn.Dense(self.num_classes, dtype=dtype, name="head")(x)
         return x.astype(jnp.float32)
 
 

@@ -14,6 +14,8 @@ trainers can fold it into the objective.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -31,6 +33,8 @@ class SwitchMoE(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     router_noise: float = 0.0
     aux_loss_weight: float = 0.01  # Switch paper's alpha
+    #: mixed-precision policy for the expert MLPs; the router stays f32
+    precision: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -76,7 +80,8 @@ class SwitchMoE(nn.Module):
             in_axes=0, out_axes=0,
             variable_axes={"params": 0},
             split_rngs={"params": True, "dropout": True},
-        )(self.mlp_dim, 0.0, self.dtype, name="experts")(expert_in)
+        )(self.mlp_dim, 0.0, self.dtype, precision=self.precision,
+          name="experts")(expert_in)
         y = jnp.einsum("nec,ecw->nw", combine.astype(self.dtype),
                        expert_out)                         # [N, W]
         return y.reshape(b, t, w)
@@ -91,6 +96,7 @@ class MoEEncoderBlock(nn.Module):
     capacity_factor: float = 1.25
     dtype: jnp.dtype = jnp.bfloat16
     aux_loss_weight: float = 0.01
+    precision: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -98,12 +104,12 @@ class MoEEncoderBlock(nn.Module):
 
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(self.dtype)
         y = MultiHeadAttention(self.num_heads, dtype=self.dtype,
-                               name="attn")(y)
+                               precision=self.precision, name="attn")(y)
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
         y = SwitchMoE(self.num_experts, self.mlp_dim, self.capacity_factor,
                       self.dtype, aux_loss_weight=self.aux_loss_weight,
-                      name="moe")(y, train=train)
+                      precision=self.precision, name="moe")(y, train=train)
         return x + y
 
 
@@ -129,6 +135,9 @@ class MoEClassifier(nn.Module):
     #: (models/remat.py); the sown aux loss and router rng pass through
     #: the lifted transform unchanged.
     remat: str = "none"
+    #: mixed-precision policy (distkeras_tpu/precision.py); router and f32
+    #: head stay f32
+    precision: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -141,7 +150,7 @@ class MoEClassifier(nn.Module):
                 num_heads=self.num_heads, num_experts=self.num_experts,
                 mlp_dim=self.mlp_dim, capacity_factor=self.capacity_factor,
                 dtype=self.dtype, aux_loss_weight=self.aux_loss_weight,
-                name=f"block{i}")(x, train)
+                precision=self.precision, name=f"block{i}")(x, train)
         x = jnp.mean(x.astype(jnp.float32), axis=1)  # pool over tokens
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
 

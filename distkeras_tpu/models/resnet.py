@@ -26,12 +26,13 @@ from __future__ import annotations
 
 import math
 from functools import partial
-from typing import Any, Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from distkeras_tpu import precision as precision_lib
 from distkeras_tpu.models.input_norm import normalize_image_input
 from distkeras_tpu.models.remat import remat_wrap
 
@@ -91,9 +92,12 @@ class ScaledWSConv(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     use_bias: bool = True
     gain_init: Any = nn.initializers.ones
+    precision: Optional[str] = None
 
     @nn.compact
     def __call__(self, x):
+        dtype, _, _, act_quant = precision_lib.resolve(self.precision,
+                                                       self.dtype)
         kh, kw = self.kernel_size
         in_ch = x.shape[-1]
         kernel = self.param("kernel", nn.initializers.normal(1.0),
@@ -105,14 +109,17 @@ class ScaledWSConv(nn.Module):
         gain = self.param("gain", self.gain_init, (self.features,),
                           jnp.float32)
         w = w * gain
+        # quantize AFTER standardization: the conv consumes exactly what a
+        # low-precision conv would see (weight standardization itself stays
+        # in f32 on the O(params) tensors)
         y = jax.lax.conv_general_dilated(
-            x.astype(self.dtype), w.astype(self.dtype),
+            act_quant(x.astype(dtype)), act_quant(w.astype(dtype)),
             window_strides=self.strides, padding=self.padding,
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
         if self.use_bias:
             b = self.param("bias", nn.initializers.zeros,
                            (self.features,), jnp.float32)
-            y = y + b.astype(self.dtype)
+            y = y + b.astype(dtype)
         return y
 
 
@@ -123,11 +130,15 @@ class BottleneckBlock(nn.Module):
     strides: int = 1
     dtype: jnp.dtype = jnp.bfloat16
     norm: str = "gn"  # "gn" | "nf" (norm-free, scaled-WS convs)
+    precision: Optional[str] = None
 
     @nn.compact
     def __call__(self, x):
+        dtype, _, conv_kw, _ = precision_lib.resolve(self.precision,
+                                                     self.dtype)
         if self.norm == "nf":
-            conv = partial(ScaledWSConv, dtype=self.dtype)
+            conv = partial(ScaledWSConv, dtype=self.dtype,
+                           precision=self.precision)
             residual = x
             y = conv(self.filters, (1, 1), name="conv1")(x)
             y = nn.relu(y) * _RELU_GAIN
@@ -144,8 +155,8 @@ class BottleneckBlock(nn.Module):
                                 strides=(self.strides, self.strides),
                                 name="proj")(residual)
             return nn.relu(residual + y)
-        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        norm = partial(group_norm, dtype=self.dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=dtype, **conv_kw)
+        norm = partial(group_norm, dtype=dtype)
         residual = x
         y = conv(self.filters, (1, 1), name="conv1")(x)
         y = norm(self.filters, name="norm1")(y)
@@ -173,11 +184,15 @@ class BasicBlock(nn.Module):
     strides: int = 1
     dtype: jnp.dtype = jnp.bfloat16
     norm: str = "gn"
+    precision: Optional[str] = None
 
     @nn.compact
     def __call__(self, x):
+        dtype, _, conv_kw, _ = precision_lib.resolve(self.precision,
+                                                     self.dtype)
         if self.norm == "nf":
-            conv = partial(ScaledWSConv, dtype=self.dtype)
+            conv = partial(ScaledWSConv, dtype=self.dtype,
+                           precision=self.precision)
             residual = x
             y = conv(self.filters, (3, 3),
                      strides=(self.strides, self.strides),
@@ -190,8 +205,8 @@ class BasicBlock(nn.Module):
                                 strides=(self.strides, self.strides),
                                 name="proj")(residual)
             return nn.relu(residual + y)
-        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        norm = partial(group_norm, dtype=self.dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=dtype, **conv_kw)
+        norm = partial(group_norm, dtype=dtype)
         residual = x
         y = conv(self.filters, (3, 3), strides=(self.strides, self.strides),
                  padding="SAME", name="conv1")(x)
@@ -233,12 +248,18 @@ class ResNet(nn.Module):
     #: checkpoints each residual block, "full" also wraps the stem conv
     #: (whose [B, 112, 112, 64] output is the single largest activation).
     remat: str = "none"
+    #: mixed-precision policy (distkeras_tpu/precision.py), the ``remat=``
+    #: -style plumbing: overrides ``dtype`` for conv/matmul compute, f32
+    #: classifier head stays f32
+    precision: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         del train  # stateless norms: train/eval forward passes are identical
+        dtype, _, conv_kw, _ = precision_lib.resolve(self.precision,
+                                                     self.dtype)
         block_cls = remat_wrap(self.block, self.remat)
-        x = normalize_image_input(x, self.dtype, self.normalize_uint8)
+        x = normalize_image_input(x, dtype, self.normalize_uint8)
         if self.space_to_depth:
             n, h, w, c = x.shape
             x = x.reshape(n, h // 2, 2, w // 2, 2, c)
@@ -252,22 +273,22 @@ class ResNet(nn.Module):
             stem_conv = remat_wrap(ScaledWSConv, self.remat, stem=True)
             x = stem_conv(self.width, stem_kernel, strides=stem_strides,
                           padding=stem_pad, dtype=self.dtype,
-                          name="conv_stem")(x)
+                          precision=self.precision, name="conv_stem")(x)
             x = nn.relu(x) * _RELU_GAIN
         elif self.space_to_depth:
             stem_conv = remat_wrap(nn.Conv, self.remat, stem=True)
             x = stem_conv(self.width, stem_kernel, strides=stem_strides,
-                          padding=stem_pad, use_bias=False, dtype=self.dtype,
-                          name="conv_stem")(x)
-            x = group_norm(self.width, dtype=self.dtype, name="norm_stem")(x)
+                          padding=stem_pad, use_bias=False, dtype=dtype,
+                          name="conv_stem", **conv_kw)(x)
+            x = group_norm(self.width, dtype=dtype, name="norm_stem")(x)
             x = nn.relu(x)
         else:
             stem_conv = remat_wrap(nn.Conv, self.remat, stem=True)
             x = stem_conv(self.width, (7, 7), strides=(2, 2),
                           padding=[(3, 3), (3, 3)],
-                          use_bias=False, dtype=self.dtype,
-                          name="conv_stem")(x)
-            x = group_norm(self.width, dtype=self.dtype, name="norm_stem")(x)
+                          use_bias=False, dtype=dtype,
+                          name="conv_stem", **conv_kw)(x)
+            x = group_norm(self.width, dtype=dtype, name="norm_stem")(x)
             x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for i, num_blocks in enumerate(self.stage_sizes):
@@ -275,6 +296,7 @@ class ResNet(nn.Module):
                 strides = 2 if i > 0 and j == 0 else 1
                 x = block_cls(filters=self.width * 2 ** i, strides=strides,
                               dtype=self.dtype, norm=self.norm,
+                              precision=self.precision,
                               name=f"stage{i}_block{j}")(x)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)

@@ -13,6 +13,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from distkeras_tpu import precision as precision_lib
 from distkeras_tpu.models.remat import remat_wrap
 from distkeras_tpu.ops.attention import MultiHeadAttention
 
@@ -21,15 +22,18 @@ class MlpBlock(nn.Module):
     mlp_dim: int
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.bfloat16
+    precision: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        dtype, dense_kw, _, _ = precision_lib.resolve(self.precision,
+                                                      self.dtype)
         width = x.shape[-1]
-        y = nn.Dense(self.mlp_dim, dtype=self.dtype, name="fc1")(x)
+        y = nn.Dense(self.mlp_dim, dtype=dtype, name="fc1", **dense_kw)(x)
         y = nn.gelu(y)
         if self.dropout_rate > 0.0:
             y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
-        return nn.Dense(width, dtype=self.dtype, name="fc2")(y)
+        return nn.Dense(width, dtype=dtype, name="fc2", **dense_kw)(y)
 
 
 class EncoderBlock(nn.Module):
@@ -37,19 +41,22 @@ class EncoderBlock(nn.Module):
     mlp_dim: int
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.bfloat16
+    precision: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask: Optional[jax.Array] = None,
                  train: bool = False):
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(self.dtype)
+        dtype = precision_lib.resolve(self.precision, self.dtype)[0]
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(dtype)
         y = MultiHeadAttention(self.num_heads, dtype=self.dtype,
-                               name="attn")(y, mask=mask)
+                               precision=self.precision, name="attn")(
+                                   y, mask=mask)
         if self.dropout_rate > 0.0:
             y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         x = x + y
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(dtype)
         y = MlpBlock(self.mlp_dim, self.dropout_rate, self.dtype,
-                     name="mlp")(y, train=train)
+                     precision=self.precision, name="mlp")(y, train=train)
         return x + y
 
 
@@ -69,6 +76,7 @@ class Encoder(nn.Module):
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.bfloat16
     remat: str = "none"
+    precision: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask: Optional[jax.Array] = None,
@@ -76,5 +84,6 @@ class Encoder(nn.Module):
         block_cls = remat_wrap(EncoderBlock, self.remat, static_argnums=(3,))
         for i in range(self.num_layers):
             x = block_cls(self.num_heads, self.mlp_dim, self.dropout_rate,
-                          self.dtype, name=f"layer_{i}")(x, mask, train)
+                          self.dtype, precision=self.precision,
+                          name=f"layer_{i}")(x, mask, train)
         return nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)

@@ -7,9 +7,12 @@ classifier head.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import flax.linen as nn
 import jax.numpy as jnp
 
+from distkeras_tpu import precision as precision_lib
 from distkeras_tpu.models.input_norm import normalize_image_input
 from distkeras_tpu.models.remat import remat_wrap
 from distkeras_tpu.models.transformer import Encoder
@@ -32,24 +35,30 @@ class ViT(nn.Module):
     #: activation rematerialization policy for the encoder blocks
     #: (models/remat.py); "full" also wraps the patch embedding.
     remat: str = "none"
+    #: mixed-precision policy (distkeras_tpu/precision.py); f32 head stays
+    #: f32
+    precision: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = normalize_image_input(x, self.dtype, self.normalize_uint8)
+        dtype, _, conv_kw, _ = precision_lib.resolve(self.precision,
+                                                     self.dtype)
+        x = normalize_image_input(x, dtype, self.normalize_uint8)
         p = self.patch_size
         patch_conv = remat_wrap(nn.Conv, self.remat, stem=True)
         x = patch_conv(self.width, (p, p), strides=(p, p), padding="VALID",
-                       dtype=self.dtype, name="patch_embed")(x)
+                       dtype=dtype, name="patch_embed", **conv_kw)(x)
         b, h, w, c = x.shape
         x = x.reshape((b, h * w, c))
         cls = self.param("cls", nn.initializers.zeros, (1, 1, self.width))
-        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, c)).astype(self.dtype),
+        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, c)).astype(dtype),
                              x], axis=1)
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (1, h * w + 1, self.width))
-        x = x + pos.astype(self.dtype)
+        x = x + pos.astype(dtype)
         x = Encoder(self.num_layers, self.num_heads, self.mlp_dim,
                     self.dropout_rate, self.dtype, remat=self.remat,
+                    precision=self.precision,
                     name="encoder")(x, train=train)
         cls_out = x[:, 0]
         return nn.Dense(self.num_classes, dtype=jnp.float32,

@@ -17,28 +17,54 @@ import jax
 
 from distkeras_tpu import telemetry
 
-# Peak dense bf16 FLOP/s per chip, by TPU generation. Public figures:
-# v2 45T, v3 123T, v4 275T, v5e ("v5 lite") 197T, v5p 459T, v6e 918T.
-PEAK_FLOPS_BF16 = {
-    "v2": 45e12,
-    "v3": 123e12,
-    "v4": 275e12,
-    "v5e": 197e12,
-    "v5 lite": 197e12,
-    "v5litepod": 197e12,
-    "v5p": 459e12,
-    "v6e": 918e12,
-    "v6 lite": 918e12,
+# Peak dense FLOP/s per chip, by TPU generation AND compute dtype — MFU for
+# a bf16 step against the bf16 ceiling is a different (harder) number than
+# the same step against an f32 ceiling, and an int8 policy that "hits 55%
+# MFU" against the bf16 table is quietly claiming half its real headroom.
+# bf16 column (public figures): v2 45T, v3 123T, v4 275T, v5e 197T, v5p
+# 459T, v6e 918T. int8: v5e/v6e run the MXU's int8 path at 2x the bf16
+# rate (394T / 1836T); v2-v4 and v5p have no accelerated int8 path, so
+# int8 work there runs at the bf16 rate. f32 is half the bf16 rate (two
+# MXU passes per f32 product). fp8 matches int8 on v6e (native fp8),
+# elsewhere fp8-sim executes as bf16.
+def _gen(bf16, int8=None, fp8=None):
+    int8 = bf16 if int8 is None else int8
+    return {"f32": bf16 / 2, "bf16": bf16, "int8": int8,
+            "fp8": int8 if fp8 else bf16}
+
+
+_GEN_PEAKS = {
+    "v2": _gen(45e12),
+    "v3": _gen(123e12),
+    "v4": _gen(275e12),
+    "v5e": _gen(197e12, int8=394e12),
+    "v5p": _gen(459e12),
+    "v6e": _gen(918e12, int8=1836e12, fp8=True),
 }
+_KIND_ALIASES = {"v5 lite": "v5e", "v5litepod": "v5e", "v6 lite": "v6e"}
+
+#: device-kind substring -> {dtype: peak FLOP/s}
+PEAK_FLOPS = dict(_GEN_PEAKS,
+                  **{alias: _GEN_PEAKS[gen]
+                     for alias, gen in _KIND_ALIASES.items()})
+
+#: back-compat view of the bf16 column (pre-r6 callers index this directly)
+PEAK_FLOPS_BF16 = {kind: peaks["bf16"] for kind, peaks in PEAK_FLOPS.items()}
 
 
-def device_peak_flops(device: Optional[jax.Device] = None) -> Optional[float]:
-    """Best-effort peak bf16 FLOP/s for one chip; None when unknown (CPU)."""
+def device_peak_flops(device: Optional[jax.Device] = None,
+                      dtype: str = "bf16") -> Optional[float]:
+    """Best-effort peak FLOP/s of one chip for a compute dtype
+    (``"f32" | "bf16" | "int8" | "fp8"``); None when unknown (CPU)."""
+    if dtype not in next(iter(PEAK_FLOPS.values())):
+        raise ValueError(
+            f"unknown peak-table dtype {dtype!r}; expected one of "
+            f"{tuple(next(iter(PEAK_FLOPS.values())))}")
     device = device or jax.devices()[0]
     kind = getattr(device, "device_kind", "").lower()
-    for key, peak in PEAK_FLOPS_BF16.items():
+    for key, peaks in PEAK_FLOPS.items():
         if key in kind:
-            return peak
+            return peaks[dtype]
     return None
 
 
@@ -168,8 +194,11 @@ def calibrate_peak(size: int = 16384, chain: int = 64, repeats: int = 3,
     (``block_until_ready`` returns early on tunneled backends) — into a
     checked invariant: if a chained big bf16 matmul doesn't land near the
     chip's book peak, one of them is wrong, and callers should refuse to
-    report MFU. Returns ``{"achieved", "peak", "ratio"}`` FLOP/s, or None
-    off-TPU. Defaults measured on this v5e: 176.9 TF/s = 0.90 of book peak
+    report MFU. The probe is a bf16 matmul, so ``ratio`` calibrates the
+    BF16 column of the peak table; the other columns are fixed
+    rate-multiples of it (see ``PEAK_FLOPS``), so one honest bf16 ratio
+    vouches for all of them. Returns ``{"achieved", "peak", "ratio"}``
+    FLOP/s, or None off-TPU. Defaults measured on this v5e: 176.9 TF/s = 0.90 of book peak
     (16384² bf16, 64-matmul scan, ~3.2 s per timed call so the one fetch
     RTT is <3%); smaller shapes measure lower (8192²: 0.83, 4096²: 0.75),
     so the default is the shape that bounds the methodology error, not the
@@ -217,15 +246,22 @@ def calibrate_peak(size: int = 16384, chain: int = 64, repeats: int = 3,
 
 
 def mfu(flops_per_step: float, step_time_s: float, num_chips: int = 1,
-        peak_per_chip: Optional[float] = None) -> Optional[float]:
-    """Model FLOPs utilization in [0,1]; None off-TPU or without a FLOPs count."""
-    peak = peak_per_chip if peak_per_chip is not None else device_peak_flops()
+        peak_per_chip: Optional[float] = None,
+        dtype: str = "bf16") -> Optional[float]:
+    """Model FLOPs utilization in [0,1]; None off-TPU or without a FLOPs
+    count. ``dtype`` selects the peak-table column the utilization is
+    measured against (a PrecisionPolicy's ``mfu_dtype`` property names the
+    right one) and labels the published gauge, so an int8 run's 30% and a
+    bf16 run's 55% stop being comparable numbers by accident."""
+    peak = peak_per_chip if peak_per_chip is not None \
+        else device_peak_flops(dtype=dtype)
     if peak is None or not flops_per_step or step_time_s <= 0:
         return None
     value = flops_per_step / (step_time_s * peak * num_chips)
     # mirror into the telemetry registry: MFU becomes queryable through the
-    # live metrics-snapshot endpoint and lands in the Prometheus export
-    telemetry.gauge("observability.mfu").set(value)
+    # live metrics-snapshot endpoint and lands in the Prometheus export,
+    # labeled by the ceiling it was measured against
+    telemetry.gauge("observability.mfu", dtype=dtype).set(value)
     telemetry.gauge("observability.flops_per_step").set(flops_per_step)
     return value
 

@@ -21,6 +21,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from distkeras_tpu import precision as precision_lib
+
 # Large-but-finite mask value (flax convention): keeps softmax defined (and
 # its gradient zero, not NaN) even for rows whose keys are ALL masked — e.g.
 # an all-padding row from ModelPredictor's static-shape tail padding.
@@ -79,18 +81,23 @@ class MultiHeadAttention(nn.Module):
     qkv_features: Optional[int] = None
     dtype: jnp.dtype = jnp.bfloat16
     causal: bool = False
+    #: mixed-precision policy for the qkv/out projections
+    #: (distkeras_tpu/precision.py); attention itself stays fp32-softmax
+    precision: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask: Optional[jax.Array] = None):
+        dtype, dense_kw, _, _ = precision_lib.resolve(self.precision,
+                                                      self.dtype)
         width = x.shape[-1]
         features = self.qkv_features or width
         head_dim = features // self.num_heads
         assert features % self.num_heads == 0
 
-        qkv = nn.Dense(3 * features, dtype=self.dtype, name="qkv")(x)
+        qkv = nn.Dense(3 * features, dtype=dtype, name="qkv", **dense_kw)(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         split = lambda t: t.reshape(t.shape[:2] + (self.num_heads, head_dim))
         out = dot_product_attention(split(q), split(k), split(v),
                                     mask=mask, causal=self.causal)
         out = out.reshape(out.shape[:2] + (features,))
-        return nn.Dense(width, dtype=self.dtype, name="out")(out)
+        return nn.Dense(width, dtype=dtype, name="out", **dense_kw)(out)

@@ -1,0 +1,73 @@
+"""Gradient-bucket collective overlap (DESIGN.md §11).
+
+The sync substrate's data-parallel fold is ONE whole-tree ``psum`` issued
+after the entire backward pass — the collective serializes behind compute.
+Partitioning the gradient pytree into size-targeted buckets and issuing
+one ``psum`` per bucket lets XLA's async collectives start each bucket's
+all-reduce as soon as its leaves' backward segments complete, hiding
+all-reduce latency behind the rest of backward (the compute-side twin of
+PR 3's comms/compute overlap).
+
+Bucketing is over the REVERSED flatten order: ``jax.tree`` flattening is
+deterministic and roughly forward-topological (embedding/stem params
+first, head last), so the reverse approximates backward completion order —
+the first bucket to fire holds the leaves whose gradients finish first.
+
+Exactness: ``jax.lax.psum`` applied to a tuple of leaves reduces each
+leaf independently — the per-leaf sums are THE SAME operations whether
+issued as one variadic psum or several, so ``bucketed_psum`` is
+bitwise-equal to the whole-tree psum (asserted in tests/test_overlap.py,
+including ragged tail buckets and the accum_steps composition).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+def partition_buckets(nbytes: Sequence[int],
+                      bucket_bytes: int) -> List[List[int]]:
+    """Greedy size-targeted grouping of leaf indices, in REVERSED index
+    order (≈ backward completion order; see module docstring).
+
+    Each bucket accumulates leaves until it holds at least
+    ``bucket_bytes``; the final (tail) bucket may be ragged — smaller than
+    the target — rather than merged backward (merging would delay the
+    last-to-complete leaves' collective, the opposite of the point).
+    Every index appears exactly once; a leaf larger than the target gets
+    its own bucket."""
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes must be positive, got {bucket_bytes}")
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    size = 0
+    for i in reversed(range(len(nbytes))):
+        cur.append(i)
+        size += int(nbytes[i])
+        if size >= bucket_bytes:
+            buckets.append(cur)
+            cur, size = [], 0
+    if cur:
+        buckets.append(cur)  # ragged tail
+    return buckets
+
+
+def bucketed_psum(tree, axis_name, bucket_bytes: Optional[int] = None):
+    """``jax.lax.psum(tree, axis_name)`` issued as one variadic psum per
+    size-targeted bucket. ``bucket_bytes=None`` is exactly today's
+    whole-tree psum (one collective)."""
+    if bucket_bytes is None:
+        return jax.lax.psum(tree, axis_name)
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return tree
+    sizes = [int(np.prod(x.shape)) * x.dtype.itemsize for x in leaves]
+    out: List = [None] * len(leaves)
+    for idxs in partition_buckets(sizes, bucket_bytes):
+        summed = jax.lax.psum(tuple(leaves[i] for i in idxs), axis_name)
+        for i, s in zip(idxs, summed):
+            out[i] = s
+    return jax.tree.unflatten(treedef, out)

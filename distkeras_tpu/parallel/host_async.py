@@ -90,7 +90,7 @@ class CadenceTrigger:
 
 def make_window_fn(model, loss, tx, strategy: Strategy, window: int,
                    metric_names: Sequence[str], seed: int,
-                   accum_steps: int = 1):
+                   accum_steps: int = 1, precision: Optional[str] = None):
     """One worker's compiled round: λ local steps + commit computation.
 
     (carry, center, batches, fold_key) -> (carry, commit, metrics dict)
@@ -102,13 +102,18 @@ def make_window_fn(model, loss, tx, strategy: Strategy, window: int,
     local step's grad fn, so a window is still λ optimizer steps and ONE
     commit — server clock, commit counts, and staleness histograms are
     unchanged by construction.
+
+    ``precision`` threads a PrecisionPolicy into the grad fns. Strategies
+    call the grad fn without a live ``loss_scale``, so the STATIC policy
+    scale applies on this path (NUMERICS.md "Low-precision step
+    equivalence") — the dynamic-scale plumbing is a sync-path feature.
     """
     accum_steps = int(accum_steps)
     if accum_steps > 1:
         grad_fn = engine.make_accum_grad_fn(model, loss, accum_steps,
-                                            metric_names)
+                                            metric_names, precision=precision)
     else:
-        grad_fn = engine.make_grad_fn(model, loss)
+        grad_fn = engine.make_grad_fn(model, loss, precision=precision)
     base_key = jax.random.key(seed)
 
     def window_fn(carry, center, batches, fold_key):
@@ -154,13 +159,14 @@ class HostAsyncRunner:
                  metrics: Sequence[str] = (), seed: int = 0,
                  devices: Optional[Sequence[jax.Device]] = None,
                  codec: Optional[str] = None, overlap: bool = False,
-                 accum_steps: int = 1):
+                 accum_steps: int = 1, precision: Optional[str] = None):
         self.strategy = strategy
         self.window = int(window)
         self.accum_steps = int(accum_steps)
         self.window_fn = make_window_fn(model, loss, tx, strategy, window,
                                         tuple(metrics), seed,
-                                        accum_steps=self.accum_steps)
+                                        accum_steps=self.accum_steps,
+                                        precision=precision)
         self.tx = tx
         # worker k runs on devices[k % D]; default = single-device mode
         self.devices = list(devices) if devices else [jax.devices()[0]]

@@ -39,7 +39,9 @@ WORKERS = mesh_lib.WORKER_AXIS
 def build_epoch_fn(model, loss, tx: optax.GradientTransformation,
                    strategy: Strategy, mesh: Mesh, num_workers: int,
                    window: int, metrics: Sequence[str] = (),
-                   dropout_seed: int = 0, accum_steps: int = 1) -> Callable:
+                   dropout_seed: int = 0, accum_steps: int = 1,
+                   precision=None,
+                   bucket_bytes: Optional[int] = None) -> Callable:
     """Compile the per-epoch distributed training function.
 
     ``num_workers`` is the LOGICAL worker count K; when it exceeds the mesh's
@@ -67,7 +69,19 @@ def build_epoch_fn(model, loss, tx: optax.GradientTransformation,
       staleness rotation across epochs),
     - ``metrics``: dict of (num_workers, rounds, window) float arrays plus
       per-round ``staleness`` (num_workers, rounds).
+
+    ``precision=`` threads a mixed-precision policy into the grad fn
+    (static loss scale — strategies call grad fns with three args, so the
+    live guard scale does not reach this path; DESIGN.md §11).
+
+    ``bucket_bytes=`` partitions the commit fold's all-reduce into
+    size-targeted buckets issued per-bucket (collectives.bucketed_psum) so
+    XLA's async collectives overlap the fold with the surrounding compute;
+    the per-leaf sums are identical, so the trajectory is bitwise-equal to
+    the unbucketed fold (tests/test_overlap.py).
     """
+    from distkeras_tpu.parallel import collectives
+
     metric_names = tuple(metrics)
     accum_steps = int(accum_steps)
     if accum_steps > 1:
@@ -75,9 +89,10 @@ def build_epoch_fn(model, loss, tx: optax.GradientTransformation,
         # but aux is {metric: (num, den)} instead of logits — strategies
         # pass it through opaquely, the step body finalizes below
         grad_fn = engine.make_accum_grad_fn(model, loss, accum_steps,
-                                            metric_names)
+                                            metric_names,
+                                            precision=precision)
     else:
-        grad_fn = engine.make_grad_fn(model, loss)
+        grad_fn = engine.make_grad_fn(model, loss, precision=precision)
     base_key = jax.random.key(dropout_seed)
     mesh_workers = mesh.shape[WORKERS]
     if num_workers % mesh_workers != 0:
@@ -137,9 +152,12 @@ def build_epoch_fn(model, loss, tx: optax.GradientTransformation,
                     ks, carry, batches, center, r_idx)
             if strategy.exchanges:
                 weighted, commits = ex
-                # fold: sum this device's replicas, then psum across devices
+                # fold: sum this device's replicas, then psum across
+                # devices — bucketed when bucket_bytes is set so the
+                # all-reduce overlaps compute (bitwise-equal either way)
                 local = jax.tree.map(lambda x: jnp.sum(x, axis=0), weighted)
-                new_center = tree_add(center, jax.lax.psum(local, WORKERS))
+                new_center = tree_add(center, collectives.bucketed_psum(
+                    local, WORKERS, bucket_bytes))
                 carry = jax.vmap(
                     lambda c, cm: strategy.post_commit(c, cm, new_center)
                 )(carry, commits)

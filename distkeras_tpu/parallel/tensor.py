@@ -24,7 +24,10 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distkeras_tpu import engine
+from distkeras_tpu import precision as precision_lib
+from distkeras_tpu.parallel import collectives
 from distkeras_tpu.parallel import mesh as mesh_lib
+from distkeras_tpu.utils.jax_compat import shard_map
 
 Rules = Sequence[Tuple[str, P]]
 
@@ -103,7 +106,9 @@ def shard_params(params: Any, mesh: Mesh,
 def build_pjit_epoch_fn(model, loss, tx: optax.GradientTransformation,
                         mesh: Mesh, metrics: Sequence[str] = (),
                         rules: Optional[Rules] = None,
-                        dropout_seed: int = 0, accum_steps: int = 1):
+                        dropout_seed: int = 0, accum_steps: int = 1,
+                        precision: Optional[str] = None,
+                        bucket_bytes: Optional[int] = None):
     """Sync data-parallel (× tensor-parallel) epoch: scan over staged steps.
 
     Returns ``(epoch_fn, place_state, place_data)``:
@@ -119,6 +124,19 @@ def build_pjit_epoch_fn(model, loss, tx: optax.GradientTransformation,
     device accumulates over its own rows and the psum stays once per
     optimizer step.
 
+    ``precision`` selects a PrecisionPolicy for the loss-scaling side of the
+    grad fns (the model's own ``precision`` field governs its compute; the
+    trainer stamps both from one knob). With a guard-wrapped optimizer the
+    step reads the live scale out of ``opt_state``; otherwise the static
+    policy scale applies.
+
+    ``bucket_bytes`` switches the step from GSPMD's implicit grad
+    all-reduce to an EXPLICIT shard_map data-parallel step whose gradient
+    psums are issued per size-targeted bucket (parallel/collectives.py), so
+    each bucket's all-reduce overlaps the rest of backward. Explicit
+    collectives and GSPMD's model-axis collectives do not compose, so this
+    mode requires a pure data-parallel mesh (``model`` axis of size 1).
+
     This is the honest sync-DP fast path (BASELINE config 5): one compiled
     program, grads all-reduced by GSPMD, params optionally model-sharded.
     """
@@ -126,32 +144,87 @@ def build_pjit_epoch_fn(model, loss, tx: optax.GradientTransformation,
     accum_steps = int(accum_steps)
     if accum_steps > 1:
         grad_fn = engine.make_accum_grad_fn(model, loss, accum_steps,
-                                            metric_names)
+                                            metric_names, precision=precision)
     else:
-        grad_fn = engine.make_grad_fn(model, loss)
+        grad_fn = engine.make_grad_fn(model, loss, precision=precision)
     base_key = jax.random.key(dropout_seed)
+    num_workers = mesh.shape[mesh_lib.WORKER_AXIS]
+    if bucket_bytes is not None and mesh.shape.get(mesh_lib.MODEL_AXIS, 1) > 1:
+        raise ValueError(
+            f"bucket_bytes={bucket_bytes} requests explicit bucketed grad "
+            f"all-reduce, which requires a pure data-parallel mesh; this "
+            f"mesh shards the model axis over "
+            f"{mesh.shape[mesh_lib.MODEL_AXIS]} devices (GSPMD's implicit "
+            f"model-parallel collectives do not compose with explicit "
+            f"shard_map psums — drop bucket_bytes or use model=1)")
 
-    def epoch(state, data, step_offset):
-        def one_step(st, xs):
-            batch, i = xs
-            rng = jax.random.fold_in(base_key, step_offset + i)
-            (loss_val, aux), grads = grad_fn(st.params, batch,
-                                             {"dropout": rng})
-            updates, opt_state = tx.update(grads, st.opt_state, st.params)
-            params = optax.apply_updates(st.params, updates)
-            out = {"loss": loss_val}
-            for name in metric_names:
-                if accum_steps > 1:
-                    out[name] = engine.finalize_metric(aux[name])
-                else:
-                    out[name] = engine.compute_metric(name, aux,
-                                                      batch["labels"])
-            return engine.TrainState(step=st.step + 1, params=params,
-                                     opt_state=opt_state), out
+    def one_step_body(st, batch, rng, fold):
+        """Shared step body; ``fold(loss, grads, aux, batch)`` injects the
+        cross-worker reduction (identity under GSPMD, bucketed psum under
+        shard_map)."""
+        scale = precision_lib.current_scale(st.opt_state)
+        (loss_val, aux), grads = grad_fn(st.params, batch,
+                                         {"dropout": rng},
+                                         loss_scale=scale)
+        loss_val, grads, metric_out = fold(loss_val, grads, aux, batch)
+        updates, opt_state = tx.update(grads, st.opt_state, st.params)
+        params = optax.apply_updates(st.params, updates)
+        out = {"loss": loss_val}
+        out.update(metric_out)
+        return engine.TrainState(step=st.step + 1, params=params,
+                                 opt_state=opt_state), out
 
-        steps = jax.tree.leaves(data)[0].shape[0]
-        idx = jnp.arange(steps, dtype=jnp.int32)
-        return jax.lax.scan(one_step, state, (data, idx))
+    def gspmd_fold(loss_val, grads, aux, batch):
+        out = {}
+        for name in metric_names:
+            if accum_steps > 1:
+                out[name] = engine.finalize_metric(aux[name])
+            else:
+                out[name] = engine.compute_metric(name, aux,
+                                                  batch["labels"])
+        return loss_val, grads, out
+
+    def bucketed_fold(loss_val, grads, aux, batch):
+        # per-shard means over equal-sized shards: pmean == global mean
+        grads = collectives.bucketed_psum(grads, mesh_lib.WORKER_AXIS,
+                                          bucket_bytes)
+        grads = jax.tree.map(lambda g: g / num_workers, grads)
+        loss_val = jax.lax.pmean(loss_val, mesh_lib.WORKER_AXIS)
+        out = {}
+        for name in metric_names:
+            if accum_steps > 1:
+                # (num, den) terms sum exactly across workers
+                out[name] = engine.finalize_metric(
+                    jax.lax.psum(aux[name], mesh_lib.WORKER_AXIS))
+            else:
+                out[name] = jax.lax.pmean(
+                    engine.compute_metric(name, aux, batch["labels"]),
+                    mesh_lib.WORKER_AXIS)
+        return loss_val, grads, out
+
+    def make_epoch(fold, decorrelate_rng):
+        def epoch(state, data, step_offset):
+            def one_step(st, xs):
+                batch, i = xs
+                rng = jax.random.fold_in(base_key, step_offset + i)
+                if decorrelate_rng:
+                    rng = jax.random.fold_in(
+                        rng, jax.lax.axis_index(mesh_lib.WORKER_AXIS))
+                return one_step_body(st, batch, rng, fold)
+
+            steps = jax.tree.leaves(data)[0].shape[0]
+            idx = jnp.arange(steps, dtype=jnp.int32)
+            return jax.lax.scan(one_step, state, (data, idx))
+        return epoch
+
+    if bucket_bytes is None:
+        epoch = make_epoch(gspmd_fold, decorrelate_rng=False)
+    else:
+        epoch = shard_map(
+            make_epoch(bucketed_fold, decorrelate_rng=True),
+            mesh=mesh,
+            in_specs=(P(), P(None, mesh_lib.WORKER_AXIS), P()),
+            out_specs=(P(), P()))
 
     data_sharding = NamedSharding(mesh, P(None, mesh_lib.WORKER_AXIS))
 

@@ -1,0 +1,401 @@
+"""Mixed-precision compute policies (DESIGN.md §11).
+
+The wire codec (comms/codec.py, PR 3) proved the int8 affine quantization
+arithmetic on parameter-server commits; this module moves the SAME rule
+(shared helpers ``affine_qparams``/``affine_quantize``/``affine_dequantize``)
+from the wire into the training step itself:
+
+- ``PrecisionPolicy`` — one of ``f32 | bf16 | int8 | fp8-sim``. ``f32`` is
+  the golden baseline; ``bf16`` runs matmuls/convs in bfloat16; ``int8``
+  computes in bf16 with per-tensor symmetric int8 quantization of matmul
+  inputs (real int8 MXU dot via ``scaled_int8_matmul``, fake-quant for
+  convs); ``fp8-sim`` simulates e4m3 quantization through
+  ``float8_e4m3fn`` round-trips on the bf16 path.
+- Master weights stay f32: flax's ``param_dtype`` default is untouched, so
+  every policy optimizes f32 params — only COMPUTE drops precision. Grad
+  accumulation stays f32 (``engine.make_accum_grad_fn``).
+- Loss scaling: the loss is multiplied by the policy's scale before
+  ``grad``, gradients unscaled in f32 after. The scale is static per policy
+  unless the optimizer is wrapped with ``overflow_guard`` — then the live
+  scale rides in the optimizer state (skip-and-rescale: a non-finite grad
+  skips the update and halves the scale; ``growth_interval`` clean steps
+  double it back, capped at ``max_scale``).
+- Per-tensor dynamic scaling: every quantized operand's scale is computed
+  from its OWN ``amax`` at trace time — no calibration pass, no state.
+
+Gradients through quantizers use the straight-through estimator (STE):
+forward sees the quantized value, backward sees identity — the standard
+rule that keeps low-precision training convergent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.comms.codec import (affine_dequantize, affine_qparams,
+                                       affine_quantize)
+
+#: symmetric int8 grid: codes 0..254 centered on 127 → signed [-127, 127]
+#: (the wire codec uses the same affine rule with levels=255, lo=min)
+_INT8_LEVELS = 254
+#: largest finite float8_e4m3fn magnitude — the fp8-sim clip point
+_FP8_E4M3_MAX = 448.0
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """A named compute-precision contract (see NUMERICS.md for the error
+    bounds each policy is tested against)."""
+
+    name: str
+    compute_dtype: Any
+    quant: Optional[str] = None        # None | "int8" | "fp8"
+    loss_scale: float = 1.0            # static / initial dynamic scale
+    growth_interval: int = 200         # clean steps between scale doublings
+    max_scale: float = 2.0 ** 15
+
+    @property
+    def mfu_dtype(self) -> str:
+        """Which hardware peak this policy's MFU is honest against:
+        fp8-sim runs its arithmetic on the bf16 MXU (the fp8 cast is a
+        simulation), so claiming the fp8 peak would flatter it."""
+        return {"f32": "f32", "bf16": "bf16", "int8": "int8",
+                "fp8-sim": "bf16"}[self.name]
+
+
+_POLICIES = {
+    "f32": PrecisionPolicy("f32", jnp.float32),
+    "bf16": PrecisionPolicy("bf16", jnp.bfloat16),
+    # bf16 compute keeps f32's exponent range, so loss scaling exists as a
+    # safety net against quantization-noise blowups, not for underflow;
+    # modest static scales keep the unscale exact (powers of two).
+    "int8": PrecisionPolicy("int8", jnp.bfloat16, quant="int8",
+                            loss_scale=2.0 ** 4),
+    "fp8-sim": PrecisionPolicy("fp8-sim", jnp.bfloat16, quant="fp8",
+                               loss_scale=2.0 ** 4),
+}
+
+PRECISION_POLICIES = tuple(_POLICIES)
+
+
+def validate_precision(precision) -> Optional[str]:
+    """Normalize a ``precision=`` knob to a policy name (or None). Raises
+    for unknown names — the model-field analogue of ``validate_remat``."""
+    if precision is None:
+        return None
+    if isinstance(precision, PrecisionPolicy):
+        precision = precision.name
+    if precision not in _POLICIES:
+        raise ValueError(
+            f"unknown precision {precision!r}; valid policies: "
+            f"{PRECISION_POLICIES} (see DESIGN.md §11)")
+    return precision
+
+
+def get_policy(precision: Union[str, PrecisionPolicy, None]
+               ) -> Optional[PrecisionPolicy]:
+    if precision is None:
+        return None
+    if isinstance(precision, PrecisionPolicy):
+        return precision
+    return _POLICIES[validate_precision(precision)]
+
+
+# -- per-tensor quantizers (shared affine rule with the wire codec) ---------
+
+def symmetric_int8_qparams(amax):
+    """Scale of the symmetric int8 grid spanning [-amax, amax]:
+    ``affine_qparams(-amax, amax, 254)`` == amax / 127."""
+    return affine_qparams(-amax, amax, _INT8_LEVELS)
+
+
+def quantize_int8(x):
+    """Per-tensor symmetric int8: ``(codes int8 in [-127,127], scale f32)``.
+    Runs through the wire codec's affine helpers with lo=-amax, levels=254
+    so one arithmetic serves both wire and step."""
+    f32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(f32))
+    scale = symmetric_int8_qparams(amax)
+    codes = affine_quantize(f32, -amax, scale, _INT8_LEVELS, xp=jnp) - 127.0
+    ok = scale > 0
+    # scale==0 (all-zero tensor): affine_quantize returns code 0, which the
+    # centered grid would read as -127; force zero codes + unit scale
+    codes = jnp.where(ok, codes, 0.0)
+    return codes.astype(jnp.int8), jnp.where(ok, scale, 1.0)
+
+
+def dequantize_int8(codes, scale, dtype):
+    """``affine_dequantize`` on the centered grid (lo=0 after the -127
+    shift): scale * codes."""
+    return affine_dequantize(codes.astype(jnp.float32), 0.0,
+                             scale).astype(dtype)
+
+
+def _fp8_roundtrip(x):
+    """Per-tensor-scaled cast through float8_e4m3fn and back — the fp8
+    simulation: exact e4m3 value grid, bf16-MXU arithmetic."""
+    f32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(f32))
+    scale = jnp.where(amax > 0, amax / _FP8_E4M3_MAX, 1.0)
+    q = (f32 / scale).astype(jnp.float8_e4m3fn).astype(jnp.float32) * scale
+    return q.astype(x.dtype)
+
+
+def fake_quant(policy: PrecisionPolicy, x):
+    """Quantize-dequantize with a straight-through gradient. The forward
+    value is exactly what the real low-precision op would consume; the
+    backward pass is identity (STE)."""
+    if policy.quant is None:
+        return x
+    if policy.quant == "int8":
+        codes, scale = quantize_int8(x)
+        deq = dequantize_int8(codes, scale, x.dtype)
+    elif policy.quant == "fp8":
+        deq = _fp8_roundtrip(x)
+    else:  # pragma: no cover - registry is closed
+        raise ValueError(f"unknown quant kind {policy.quant!r}")
+    return x + jax.lax.stop_gradient(deq - x)
+
+
+# -- the scaled-int8 matmul hot path ----------------------------------------
+
+def _int8_dot_impl(qx, sx, qw, sw, out_dtype):
+    """int8 x int8 -> int32 accumulate, dequantized by the product of the
+    per-tensor scales. Dispatches to the fused Pallas kernel when it is
+    enabled, on TPU, and the shapes tile (ops/pallas/int8_matmul.py);
+    otherwise the pure-XLA int8 dot — selected at trace time."""
+    from distkeras_tpu.ops.pallas import int8_matmul as _k
+
+    if _k.kernel_enabled() and _k.fits(qx.shape, qw.shape):
+        return _k.int8_matmul_dequant(qx, qw, sx * sw).astype(out_dtype)
+    dnums = (((qx.ndim - 1,), (0,)), ((), ()))
+    acc = jax.lax.dot_general(qx, qw, dnums,
+                              preferred_element_type=jnp.int32)
+    return (acc.astype(jnp.float32) * (sx * sw)).astype(out_dtype)
+
+
+@jax.custom_vjp
+def scaled_int8_matmul(x, w):
+    """``x @ w`` where both operands are per-tensor symmetrically quantized
+    to int8 and the product is accumulated in int32 — the MXU's 2x-rate
+    path on v5e/v6e. ``x``: (..., K), ``w``: (K, N). Backward is the STE
+    rule on the DEQUANTIZED residuals (int8 codes + scales are what's
+    saved, ~4x less residual memory than the f32 inputs)."""
+    out, _ = _scaled_int8_matmul_fwd(x, w)
+    return out
+
+
+def _scaled_int8_matmul_fwd(x, w):
+    qx, sx = quantize_int8(x)
+    qw, sw = quantize_int8(w)
+    out = _int8_dot_impl(qx, sx, qw, sw, x.dtype)
+    return out, (qx, sx, qw, sw)
+
+
+def _scaled_int8_matmul_bwd(res, g):
+    qx, sx, qw, sw = res
+    dt = g.dtype  # cotangent dtype == primal output dtype == compute dtype
+    g = g.astype(dt)
+    xh = dequantize_int8(qx, sx, dt)
+    wh = dequantize_int8(qw, sw, dt)
+    # dx = g @ ŵᵀ : (..., N) x (K, N) contracted on N -> (..., K)
+    dx = jax.lax.dot_general(g, wh, (((g.ndim - 1,), (1,)), ((), ())))
+    # dw = x̂ᵀ @ g : contract every leading (batch) dim -> (K, N)
+    lead = tuple(range(xh.ndim - 1))
+    dw = jax.lax.dot_general(xh, g, ((lead, lead), ((), ())))
+    return dx.astype(dt), dw.astype(dt)
+
+
+scaled_int8_matmul.defvjp(_scaled_int8_matmul_fwd, _scaled_int8_matmul_bwd)
+
+
+# -- flax layer hooks -------------------------------------------------------
+
+def make_dot_general(policy: Optional[PrecisionPolicy]
+                     ) -> Optional[Callable]:
+    """A ``dot_general`` replacement for ``nn.Dense(dot_general=...)``.
+    int8 policies route the canonical Dense contraction ((ndim-1,),(0,))
+    through ``scaled_int8_matmul``; anything else (and fp8) falls back to
+    fake-quant inputs + the normal dot in compute dtype. None when the
+    policy doesn't quantize (plain dtype handling suffices)."""
+    if policy is None or policy.quant is None:
+        return None
+
+    def dot_general(lhs, rhs, dimension_numbers, precision=None,
+                    preferred_element_type=None):
+        (lc, rc), (lb, rb) = dimension_numbers
+        if (policy.quant == "int8" and not lb and not rb
+                and tuple(lc) == (lhs.ndim - 1,) and tuple(rc) == (0,)
+                and rhs.ndim == 2):
+            return scaled_int8_matmul(lhs, rhs)
+        return jax.lax.dot_general(
+            fake_quant(policy, lhs), fake_quant(policy, rhs),
+            dimension_numbers, precision=precision,
+            preferred_element_type=preferred_element_type)
+
+    return dot_general
+
+
+def make_conv_general(policy: Optional[PrecisionPolicy]
+                      ) -> Optional[Callable]:
+    """A ``conv_general_dilated`` replacement for
+    ``nn.Conv(conv_general_dilated=...)``: fake-quant both operands, run
+    the regular conv in compute dtype (XLA has no int8 conv worth using on
+    TPU; the numerics are what the parity tests pin down)."""
+    if policy is None or policy.quant is None:
+        return None
+
+    def conv_general_dilated(lhs, rhs, *args, **kwargs):
+        return jax.lax.conv_general_dilated(
+            fake_quant(policy, lhs), fake_quant(policy, rhs),
+            *args, **kwargs)
+
+    return conv_general_dilated
+
+
+def resolve(precision, dtype):
+    """Model-side plumbing (the ``remat=``-style pattern): resolve a
+    model's ``precision`` field against its ``dtype`` field.
+
+    Returns ``(compute_dtype, dense_kwargs, conv_kwargs, act_quant)``:
+    ``dense_kwargs``/``conv_kwargs`` are splatted into ``nn.Dense`` /
+    ``nn.Conv`` call sites, ``act_quant`` is the fake-quant callable for
+    layers that call ``lax`` ops directly (identity when not quantizing).
+    ``precision=None`` leaves the model's own dtype untouched."""
+    if precision is None:
+        return dtype, {}, {}, (lambda x: x)
+    policy = get_policy(precision)
+    dg = make_dot_general(policy)
+    cg = make_conv_general(policy)
+    return (policy.compute_dtype,
+            {"dot_general": dg} if dg is not None else {},
+            {"conv_general_dilated": cg} if cg is not None else {},
+            (lambda x: fake_quant(policy, x)))
+
+
+# -- loss scaling + overflow skip-and-rescale -------------------------------
+
+class OverflowGuardState(tuple):
+    """Optimizer-state wrapper ``(inner, scale, good_steps)`` — a pytree
+    the step body can recognize (``current_scale``) to feed the LIVE loss
+    scale into the forward pass."""
+
+    __slots__ = ()
+
+    def __new__(cls, inner, scale, good_steps):
+        return tuple.__new__(cls, (inner, scale, good_steps))
+
+    @property
+    def inner(self):
+        return self[0]
+
+    @property
+    def scale(self):
+        return self[1]
+
+    @property
+    def good_steps(self):
+        return self[2]
+
+
+jax.tree_util.register_pytree_node(
+    OverflowGuardState,
+    lambda s: (tuple(s), None),
+    lambda _, kids: OverflowGuardState(*kids))
+
+
+def current_scale(opt_state):
+    """The live loss scale riding in a guard-wrapped optimizer state, or
+    None when the optimizer isn't guarded (static policy scale applies)."""
+    if isinstance(opt_state, OverflowGuardState):
+        return opt_state.scale
+    return None
+
+
+def overflow_guard(tx, policy: PrecisionPolicy):
+    """Wrap an optax transformation with loss-scale bookkeeping and
+    non-finite-gradient protection:
+
+    - non-finite grads: the update is zeroed, the inner optimizer state is
+      left untouched (the bad step never happened), the scale halves
+      (floor 1), and the clean-step counter resets;
+    - finite grads: normal inner update; every ``growth_interval`` clean
+      steps the scale doubles, capped at ``max_scale``.
+
+    The gradients reaching this wrapper are already UNSCALED (the grad fn
+    divides by the scale it applied), so the inner optimizer composes
+    unchanged — wrapping happens once at trainer construction so the
+    opt-state treedef is consistent across checkpoints/resume."""
+    import optax
+
+    def init(params):
+        return OverflowGuardState(tx.init(params),
+                                  jnp.float32(policy.loss_scale),
+                                  jnp.int32(0))
+
+    def update(grads, state, params=None):
+        finite = jnp.all(jnp.stack([
+            jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]))
+        updates, new_inner = tx.update(grads, state.inner, params)
+        # scalar-predicate selects: the skip path keeps the OLD inner state
+        # and emits zero updates, so a NaN batch is a true no-op step
+        updates = jax.tree.map(
+            lambda u: jnp.where(finite, u, jnp.zeros_like(u)), updates)
+        new_inner = jax.tree.map(
+            lambda n, o: jnp.where(finite, n, o), new_inner, state.inner)
+        good = jnp.where(finite, state.good_steps + 1, 0)
+        grow = finite & (good % policy.growth_interval == 0)
+        scale = jnp.where(
+            finite,
+            jnp.where(grow,
+                      jnp.minimum(state.scale * 2.0, policy.max_scale),
+                      state.scale),
+            jnp.maximum(state.scale * 0.5, 1.0))
+        return updates, OverflowGuardState(new_inner, scale,
+                                           good.astype(jnp.int32))
+
+    return optax.GradientTransformation(init, update)
+
+
+def scale_grads_fn(policy: Optional[PrecisionPolicy]):
+    """The (pre_scale, post_unscale) pair the engine's grad fns use:
+    ``pre(loss, S)`` scales the objective, ``post(grads, S)`` unscales the
+    gradients in f32 (exact for the power-of-two scales the guard emits).
+    Identity pair for None / unit-scale policies."""
+    if policy is None:
+        return None
+
+    def pre(loss, scale):
+        return loss * scale.astype(loss.dtype)
+
+    def post(grads, scale):
+        inv = 1.0 / scale
+        return jax.tree.map(
+            lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
+
+    return pre, post
+
+
+def apply_to_model(model, precision):
+    """Trainer-side plumbing: stamp a validated policy name onto a model's
+    ``precision`` field (``Module.clone`` — modules are frozen). A model
+    without the field can't honor the contract, so that's an error, not a
+    silent no-op."""
+    name = validate_precision(precision)
+    if name is None:
+        return model
+    if not hasattr(model, "precision"):
+        raise ValueError(
+            f"precision={name!r} was requested but "
+            f"{type(model).__name__} has no `precision` field; every "
+            f"distkeras_tpu model family exposes one (models/*.py) — "
+            f"custom models must add it to opt into mixed precision")
+    if model.precision is not None and model.precision != name:
+        raise ValueError(
+            f"trainer precision={name!r} contradicts the model's own "
+            f"precision={model.precision!r}; set it in one place")
+    return model.clone(precision=name)

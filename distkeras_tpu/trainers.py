@@ -29,6 +29,7 @@ import numpy as np
 import optax
 
 from distkeras_tpu import engine, telemetry
+from distkeras_tpu import precision as precision_lib
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.telemetry import span
 from distkeras_tpu.ops import losses as losses_lib
@@ -49,7 +50,8 @@ class Trainer:
                  batch_size: int = 32, num_epoch: int = 1, seed: int = 0,
                  loss_weights=None,
                  checkpoint_dir: Optional[str] = None,
-                 telemetry_path: Optional[str] = None):
+                 telemetry_path: Optional[str] = None,
+                 precision: Optional[str] = None):
         self.model = model
         self.loss = loss
         base_loss = losses_lib.get(loss)  # fail fast on unknown loss names
@@ -85,6 +87,18 @@ class Trainer:
         self.telemetry_path = telemetry_path
 
         self.tx = opt_lib.get(worker_optimizer, learning_rate)
+        # mixed-precision policy (DESIGN.md §11): validate EARLY, stamp the
+        # policy name onto the model's `precision` field (errors if the model
+        # doesn't expose one), and guard-wrap the optimizer with loss-scale
+        # bookkeeping only when the policy actually scales (int8/fp8-sim) —
+        # f32/bf16 policies keep the optimizer state treedef untouched.
+        self.precision = precision_lib.validate_precision(precision)
+        if self.precision is not None:
+            self.model = precision_lib.apply_to_model(self.model,
+                                                      self.precision)
+            policy = precision_lib.get_policy(self.precision)
+            if policy.loss_scale != 1.0:
+                self.tx = precision_lib.overflow_guard(self.tx, policy)
         self.params = None
         self.history: list[dict] = []
         self.training_time: float = 0.0
@@ -298,12 +312,15 @@ class DistributedTrainer(Trainer):
                  comms_overlap: bool = False,
                  health=None,
                  accum_steps: int = 1,
+                 precision: Optional[str] = None,
+                 bucket_bytes: Optional[int] = None,
                  **strategy_kwargs):
         super().__init__(model, loss, worker_optimizer, learning_rate,
                          metrics, features_col, label_col, batch_size,
                          num_epoch, seed, loss_weights=loss_weights,
                          checkpoint_dir=checkpoint_dir,
-                         telemetry_path=telemetry_path)
+                         telemetry_path=telemetry_path,
+                         precision=precision)
         from distkeras_tpu.parallel import mesh as mesh_lib
 
         if mode not in ("sync", "host_async"):
@@ -416,6 +433,21 @@ class DistributedTrainer(Trainer):
                 f"batch_size={self.batch_size}: each step is a scan over "
                 f"accum_steps equal microbatches (unequal microbatches would "
                 f"break the mean-loss equivalence — see NUMERICS.md)")
+        # gradient-bucket collective overlap (DESIGN.md §11): sync mode's
+        # in-graph psum is the only place a bucketed all-reduce exists;
+        # host_async commits travel the host wire (codec/comms_overlap are
+        # that path's knobs)
+        if bucket_bytes is not None:
+            if mode != "sync":
+                raise ValueError(
+                    "bucket_bytes tunes the sync substrate's in-graph grad "
+                    "psum; host_async exchanges params over the host wire "
+                    "(use codec=/comms_overlap= there)")
+            bucket_bytes = int(bucket_bytes)
+            if bucket_bytes <= 0:
+                raise ValueError(
+                    f"bucket_bytes must be positive, got {bucket_bytes}")
+        self.bucket_bytes = bucket_bytes
         self.num_updates = 0
         self.staleness_history: list[float] = []
 
@@ -644,7 +676,9 @@ class DistributedTrainer(Trainer):
                 self._epoch_fn = substrate.build_epoch_fn(
                     self.model, self.loss, self.tx, self.strategy, self.mesh,
                     self.num_workers, self.communication_window, self.metrics,
-                    dropout_seed=self.seed, accum_steps=self.accum_steps)
+                    dropout_seed=self.seed, accum_steps=self.accum_steps,
+                    precision=self.precision,
+                    bucket_bytes=self.bucket_bytes)
         epoch_fn = self._epoch_fn
         self.history = []
         self.staleness_history = []
@@ -869,7 +903,8 @@ class DistributedTrainer(Trainer):
                     self.communication_window, self.metrics, self.seed,
                     devices=self.devices or jax.local_devices(),
                     codec=self.codec, overlap=self.comms_overlap,
-                    accum_steps=self.accum_steps)
+                    accum_steps=self.accum_steps,
+                    precision=self.precision)
         runner = self._async_runner
         watchdog = None
         if self.health is not None:
@@ -1034,12 +1069,15 @@ class PjitTrainer(Trainer):
                  staging_steps: Optional[int] = None,
                  data_layout: str = "replicated",
                  telemetry_path: Optional[str] = None,
-                 accum_steps: int = 1):
+                 accum_steps: int = 1,
+                 precision: Optional[str] = None,
+                 bucket_bytes: Optional[int] = None):
         super().__init__(model, loss, worker_optimizer, learning_rate,
                          metrics, features_col, label_col, batch_size,
                          num_epoch, seed, loss_weights=loss_weights,
                          checkpoint_dir=checkpoint_dir,
-                         telemetry_path=telemetry_path)
+                         telemetry_path=telemetry_path,
+                         precision=precision)
         from distkeras_tpu.parallel import mesh as mesh_lib
 
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(
@@ -1079,6 +1117,25 @@ class PjitTrainer(Trainer):
                 f"(global batch_size {self.batch_size} / num_workers "
                 f"{self.num_workers}) so each microbatch shards evenly over "
                 f"the workers axis")
+        # gradient-bucket overlap (DESIGN.md §11): explicit shard_map DP
+        # step with per-bucket psums. Validated here AND in
+        # tensor.build_pjit_epoch_fn (the mesh check lives there); the
+        # model-parallel incompatibility is a construction-time error.
+        if bucket_bytes is not None:
+            bucket_bytes = int(bucket_bytes)
+            if bucket_bytes <= 0:
+                raise ValueError(
+                    f"bucket_bytes must be positive, got {bucket_bytes}")
+            if self.mesh.shape.get(mesh_lib.MODEL_AXIS, 1) > 1:
+                raise ValueError(
+                    f"bucket_bytes={bucket_bytes} (explicit bucketed grad "
+                    f"all-reduce) requires a pure data-parallel mesh, but "
+                    f"model_parallelism="
+                    f"{self.mesh.shape[mesh_lib.MODEL_AXIS]} shards params "
+                    f"over the model axis — GSPMD's implicit model-parallel "
+                    f"collectives do not compose with explicit shard_map "
+                    f"psums")
+        self.bucket_bytes = bucket_bytes
 
     def train(self, dataset: Dataset, shuffle: bool = False,
               resume: bool = False):
@@ -1126,7 +1183,9 @@ class PjitTrainer(Trainer):
                 self._pjit_fns = tensor.build_pjit_epoch_fn(
                     self.model, self.loss, self.tx, self.mesh, self.metrics,
                     self.partition_rules, dropout_seed=self.seed,
-                    accum_steps=self.accum_steps)
+                    accum_steps=self.accum_steps,
+                    precision=self.precision,
+                    bucket_bytes=self.bucket_bytes)
         epoch_fn, place_state, place_data = self._pjit_fns
         if positions is not None:
             data_sharding = NamedSharding(
@@ -1231,7 +1290,8 @@ class SingleTrainer(Trainer):
             with span("trainer.compile"):
                 self._epoch_fn = engine.make_epoch_fn(
                     self.model, self.loss, self.tx, metrics=self.metrics,
-                    dropout_seed=self.seed, accum_steps=self.accum_steps)
+                    dropout_seed=self.seed, accum_steps=self.accum_steps,
+                    precision=self.precision)
         epoch_fn = self._epoch_fn
         staged = None
         device_history = []  # device arrays; fetched once at the end

@@ -20,7 +20,7 @@ PKG_MODULES = sorted(
 
 def test_discovery_found_the_tools():
     # the floor protects against the glob silently matching nothing
-    assert len(SCRIPTS) >= 9, SCRIPTS
+    assert len(SCRIPTS) >= 10, SCRIPTS
     assert "distkeras_tpu.benchmarks.run_config" in PKG_MODULES
     # the serving load generator (ISSUE 2) must be under the smoke glob
     assert any(os.path.basename(p) == "serving_load.py" for p in SCRIPTS)
@@ -30,11 +30,17 @@ def test_discovery_found_the_tools():
     assert any(os.path.basename(p) == "health_probe.py" for p in SCRIPTS)
     # the memory-for-compute sweep (ISSUE 5) rides step_probe
     assert any(os.path.basename(p) == "step_probe.py" for p in SCRIPTS)
+    # the int8-kernel ablation gate (ISSUE 6) too
+    assert any(os.path.basename(p) == "int8_matmul_ablate.py"
+               for p in SCRIPTS)
 
 
 def test_step_probe_exposes_sweep_api():
-    """The accum x remat sweep (ISSUE 5) must stay addressable: sweep mode
-    in the CLI and the sweep_probe/largest_batch entry points."""
+    """The accum x remat sweep (ISSUE 5) and its precision/overlap axes
+    (ISSUE 6) must stay addressable: sweep mode in the CLI and the
+    sweep_probe/largest_batch/overlap_probe entry points."""
+    import inspect
+
     path = os.path.join(REPO, "benchmarks", "step_probe.py")
     spec = importlib.util.spec_from_file_location("step_probe_sweep", path)
     mod = importlib.util.module_from_spec(spec)
@@ -42,6 +48,9 @@ def test_step_probe_exposes_sweep_api():
     assert callable(mod.sweep_probe)
     assert callable(mod.largest_batch)
     assert callable(mod.build_family)
+    assert callable(mod.overlap_probe)
+    assert "precision" in inspect.signature(mod.sweep_probe).parameters
+    assert "precision" in inspect.signature(mod.build_family).parameters
 
 
 @pytest.mark.parametrize("path", SCRIPTS,
