@@ -1,0 +1,25 @@
+"""dktlint — the repo's self-hosted static-analysis suite (DESIGN.md §12).
+
+Run it with ``python -m distkeras_tpu.analysis``; the pytest gate
+(tests/test_lint_clean.py) self-hosts it on the repo in tier-1. Checkers:
+
+- jit-purity: host effects / closure mutation / tracer branches inside
+  functions handed to jit, shard_map, lax.scan, pallas_call;
+- locks: blocking calls under a held threading lock, lock-order cycles;
+- wire: client/server op-string and error-taxonomy drift across the three
+  socket protocols;
+- telemetry-registry: producers/consumers vs telemetry.METRIC_NAMES;
+- precision: f32 pins on LayerNorm / heads / routers / softmax inputs;
+- layering: the declared import-layer graph (health/comms/telemetry are
+  jax-free, serving never imports trainers, models sit below parallel).
+
+Everything is stdlib-``ast`` based: the suite reads repo *source* and
+never imports repo modules, so it runs on hosts without jax.
+"""
+
+from distkeras_tpu.analysis.core import (Checker, Finding, ModuleInfo,
+                                         Report, collect_modules,
+                                         default_checkers, run_suite)
+
+__all__ = ["Checker", "Finding", "ModuleInfo", "Report",
+           "collect_modules", "default_checkers", "run_suite"]

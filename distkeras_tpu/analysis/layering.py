@@ -1,0 +1,95 @@
+"""Import-layering checker: the declared layer graph.
+
+Generalizes the old ad-hoc "no-jax source rule" from tests/test_health.py
+into a single declared table. Each rule maps a path pattern to import
+prefixes that source under it may never import — not even lazily inside a
+function: a lazy ``import jax`` still drags the runtime into the health
+plane the moment the code path runs, which is exactly what the health
+plane's "debuggable while training is wedged" contract forbids.
+
+``layer-forbidden-import``
+    An ``import X`` / ``from X import ...`` whose module matches a
+    forbidden prefix for the file's layer.
+
+Declared layers (LAYER_RULES):
+- ``telemetry.py``, ``health/*``, ``comms/*`` are jax-free: they must be
+  importable (and runnable) on a host with no accelerator stack, and must
+  never trigger device initialization from a monitoring path.
+- ``serving/*`` never imports ``trainers`` — inference hosts do not carry
+  the training loop.
+- ``models/*`` never imports ``parallel``/``trainers``/``serving`` —
+  model definitions sit below every orchestration layer.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import List, Sequence, Tuple
+
+from distkeras_tpu.analysis.core import Checker, Finding, ModuleInfo
+
+# (path glob, forbidden import prefixes, one-line rationale)
+LAYER_RULES: Tuple[Tuple[str, Tuple[str, ...], str], ...] = (
+    ("distkeras_tpu/telemetry.py",
+     ("jax", "flax", "optax", "orbax"),
+     "telemetry is step-path instrumentation and must stay importable "
+     "without an accelerator stack"),
+    ("distkeras_tpu/health/*.py",
+     ("jax", "flax", "optax", "orbax"),
+     "the health plane must work while the device runtime is wedged"),
+    ("distkeras_tpu/comms/*.py",
+     ("jax", "flax", "optax", "orbax"),
+     "wire codecs run on CPU hosts (drivers, probes) with no jax"),
+    ("distkeras_tpu/serving/*.py",
+     ("distkeras_tpu.trainers",),
+     "inference hosts do not carry the training loop"),
+    ("distkeras_tpu/models/*.py",
+     ("distkeras_tpu.parallel", "distkeras_tpu.trainers",
+      "distkeras_tpu.serving"),
+     "model definitions sit below every orchestration layer"),
+)
+
+
+def _imported_modules(tree: ast.AST):
+    """Yield (module_name, lineno, col) for every import, however deep
+    (function-local lazy imports included — they still execute)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                yield alias.name, node.lineno, node.col_offset
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.level == 0:
+                yield node.module, node.lineno, node.col_offset
+
+
+def _matches(module: str, prefix: str) -> bool:
+    return module == prefix or module.startswith(prefix + ".")
+
+
+class LayeringChecker(Checker):
+    name = "layering"
+    rules = ("layer-forbidden-import",)
+
+    def __init__(self, layer_rules: Sequence[Tuple[str, Tuple[str, ...],
+                                                   str]] = LAYER_RULES):
+        self.layer_rules = tuple(layer_rules)
+
+    def check(self, modules: List[ModuleInfo]) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in modules:
+            if mod.tree is None:
+                continue
+            for pattern, forbidden, why in self.layer_rules:
+                if not fnmatch.fnmatch(mod.relpath, pattern):
+                    continue
+                for name, line, col in _imported_modules(mod.tree):
+                    for prefix in forbidden:
+                        if _matches(name, prefix):
+                            out.append(Finding(
+                                "layer-forbidden-import", mod.relpath,
+                                line, col,
+                                f"`import {name}` violates the layer "
+                                f"rule for {pattern} (forbids "
+                                f"{prefix}): {why}"))
+        return out

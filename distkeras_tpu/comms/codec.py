@@ -290,7 +290,9 @@ class EncodedParameterServer:
         self._treedef = None
 
     def _flatten(self, tree):
-        import jax
+        # trainer-host-only path: the codec MODULE stays jax-free (CPU
+        # probes import it); flattening live pytrees necessarily needs jax
+        import jax  # dktlint: disable=layer-forbidden-import
 
         from distkeras_tpu.utils.fetch import device_get_batched
 
@@ -303,7 +305,8 @@ class EncodedParameterServer:
         return leaves
 
     def _roundtrip(self, tree, kind: str):
-        import jax
+        # trainer-host-only path, same contract as _flatten above
+        import jax  # dktlint: disable=layer-forbidden-import
 
         leaves = self._flatten(tree)
         if kind == "commit":

@@ -38,7 +38,9 @@ class MLP(nn.Module):
             x = nn.relu(x)
             if self.dropout_rate > 0.0:
                 x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        x = nn.Dense(self.num_classes, dtype=dtype, name="head")(x)
+        # head computes in f32 under every policy (the "head stays
+        # unquantized" contract above — every other family already pins it)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
         return x.astype(jnp.float32)
 
 

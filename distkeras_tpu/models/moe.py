@@ -169,7 +169,9 @@ def ep_partition_rules():
     """EP rules as PartitionSpecs, prepended to the defaults."""
     from jax.sharding import PartitionSpec as P
 
-    from distkeras_tpu.parallel import tensor
+    # sharding-layer bridge, lazy so the MoE model definition itself stays
+    # importable below parallel/ (only this helper reaches up)
+    from distkeras_tpu.parallel import tensor  # dktlint: disable=layer-forbidden-import
 
     converted = tuple((pat, P(*axes)) for pat, axes in EP_RULES)
     return converted + tuple(tensor.DEFAULT_RULES)

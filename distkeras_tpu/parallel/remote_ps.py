@@ -310,19 +310,24 @@ class ParameterServerService:
                 self._hist_cv.notify_all()
             self._reply(conn, op, {"ok": True})
         elif op == "history_get":
-            # blocks until EVERY process uploaded — the end-of-run barrier
+            # blocks until EVERY process uploaded — the end-of-run barrier.
+            # The timeout reply is sent AFTER the cv is released: a socket
+            # send under self._hist_cv would freeze every history_put
+            # worker behind a slow reader's TCP window for the full I/O
+            # wait (dktlint: lock-blocking-call).
             with self._hist_cv:
                 self._hist_cv.wait_for(
                     lambda: len(self._histories) >= self.expected,
                     timeout=header.get("timeout", 600))
-                if len(self._histories) < self.expected:
-                    _sendall(conn, {"error": "history barrier timeout: "
-                                    f"{sorted(self._histories)} of "
-                                    f"{self.expected} processes uploaded"})
-                    return
+                uploaded = sorted(self._histories)
                 merged = sorted(
                     (w for ws in self._histories.values() for w in ws),
                     key=lambda w: w[0])
+            if len(uploaded) < self.expected:
+                _sendall(conn, {"error": "history barrier timeout: "
+                                f"{uploaded} of "
+                                f"{self.expected} processes uploaded"})
+                return
             center, clock = self.ps.pull()
             self._reply(conn, op, {"windows": merged, "clock": clock},
                         codec.encode(center, kind="pull"))
@@ -415,7 +420,10 @@ class RemoteParameterServer:
         ticket = object()
         with self._send_lock:
             # enqueue BEFORE releasing the send lock: wire order and
-            # waiter order must agree or responses would cross-match
+            # waiter order must agree or responses would cross-match.
+            # Sending under the lock is the point: it serializes frames on
+            # the shared socket (pipelining happens at the recv side).
+            # dktlint: disable=lock-blocking-call
             _sendall(self._sock, header, blobs)
             with self._recv_cv:
                 self._pending.append(ticket)
@@ -451,14 +459,18 @@ class RemoteParameterServer:
         data connection is mid-way through a large commit."""
         if self.token is not None:
             header = dict(header, token=self.token)
+        # the control channel is intentionally one-request-at-a-time: the
+        # lock held over connect/send/recv IS the serialization (only
+        # small header-only frames travel here, bounded by self._timeout)
         with self._ctrl_lock:
             if self._ctrl_sock is None:
+                # dktlint: disable=lock-blocking-call
                 self._ctrl_sock = socket.create_connection(
                     self._addr, timeout=self._timeout)
                 self._ctrl_sock.setsockopt(socket.IPPROTO_TCP,
                                            socket.TCP_NODELAY, 1)
-            _sendall(self._ctrl_sock, header)
-            resp, _ = _recv(self._ctrl_sock)
+            _sendall(self._ctrl_sock, header)  # dktlint: disable=lock-blocking-call
+            resp, _ = _recv(self._ctrl_sock)  # dktlint: disable=lock-blocking-call
         if "error" in resp:
             raise RuntimeError(f"parameter service: {resp['error']}")
         return resp

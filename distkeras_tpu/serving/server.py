@@ -49,6 +49,12 @@ from distkeras_tpu.serving.batching import (
 from distkeras_tpu.serving.engine import ServingEngine
 
 
+# The serving error taxonomy, declared once: clients and tests dispatch on
+# these strings, and the dktlint wire checker asserts the set of "kind"
+# values this module actually emits stays exactly equal to this tuple.
+ERROR_KINDS = ("auth", "bad_request", "closed", "deadline", "queue_full")
+
+
 def _error_kind(exc: Exception) -> str:
     if isinstance(exc, DeadlineExceeded):
         return "deadline"
@@ -206,9 +212,11 @@ class ServingClient:
     def _roundtrip(self, header: dict, blobs=()) -> Tuple[dict, list]:
         if self.token is not None:
             header = dict(header, token=self.token)
+        # by-design: the lock held over send+recv serializes callers on
+        # the single shared connection (documented contention profile)
         with self._lock:
-            send_message(self._sock, header, blobs)
-            return recv_message(self._sock)
+            send_message(self._sock, header, blobs)  # dktlint: disable=lock-blocking-call
+            return recv_message(self._sock)  # dktlint: disable=lock-blocking-call
 
     def infer(self, rows, timeout_ms: Optional[float] = None) -> np.ndarray:
         x = np.ascontiguousarray(np.asarray(rows))

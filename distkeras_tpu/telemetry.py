@@ -54,9 +54,132 @@ __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "get_registry", "install", "uninstall", "reset",
     "counter", "gauge", "histogram", "span", "load_jsonl",
+    "METRIC_NAMES", "METRIC_PREFIXES", "declared_kind",
 ]
 
 SCHEMA_VERSION = 1
+
+#: The metric-name registry: every metric the package produces, declared
+#: once, name -> instrument kind. Two readers share this dict as the
+#: single source of truth: the runtime (``MetricsRegistry._get`` raises on
+#: a declared name used with the wrong kind) and the dktlint
+#: telemetry-registry checker (``distkeras_tpu/analysis/registry.py``
+#: parses this literal from the AST and cross-checks every producer call
+#: and consumer reference in the repo). Ad-hoc names outside the declared
+#: namespaces (tests, experiments) remain legal — the registry constrains
+#: the names it knows about, it does not close the namespace.
+#:
+#: Keep this a LITERAL dict of string keys/values: the lint suite reads it
+#: without importing this module.
+METRIC_NAMES = {
+    # comms wire accounting (codec + both remote_ps sides)
+    "comms.bytes_recv": "counter",
+    "comms.bytes_sent": "counter",
+    "comms.compress_ratio": "histogram",
+    "comms.negotiated": "counter",
+    # data plane
+    "data.prefetch.producer_wait_s": "histogram",
+    "data.prefetch.puts": "counter",
+    "data.prefetch.queue_depth": "gauge",
+    "data.prefetch.queue_depth_samples": "histogram",
+    # fault injection
+    "fault.injected": "counter",
+    # health plane
+    "health.straggler.events": "counter",
+    "health.stragglers": "gauge",
+    "health.watchdog.idle_s": "gauge",
+    "health.watchdog.last_loss": "gauge",
+    "health.watchdog.last_update_norm": "gauge",
+    "health.watchdog.tripped": "gauge",
+    "health.watchdog.trips": "counter",
+    "health.worker.clock": "gauge",
+    "health.worker.heartbeat_time": "gauge",
+    "health.worker.staleness": "gauge",
+    "health.worker.straggler": "gauge",
+    "health.worker.window_s": "gauge",
+    "health.worker.windows": "counter",
+    # host-driven async trainer
+    "host_async.commit_clock_lag": "histogram",
+    "host_async.commit_s": "histogram",
+    "host_async.pull_s": "histogram",
+    "host_async.save.count": "counter",
+    "host_async.save_s": "histogram",
+    "host_async.window_s": "histogram",
+    # compute-side observability
+    "observability.achieved_flops": "gauge",
+    "observability.calibration_ratio": "gauge",
+    "observability.cost_analysis_unavailable": "counter",
+    "observability.flops.while_floor": "counter",
+    "observability.flops_per_step": "gauge",
+    "observability.mfu": "gauge",
+    "observability.peak_flops": "gauge",
+    # in-process parameter servers
+    "ps.commit.count": "counter",
+    "ps.commit.handle_s": "histogram",
+    "ps.commit.staleness": "histogram",
+    "ps.pull.count": "counter",
+    # remote (socket) parameter server
+    "remote_ps.client.bytes_received": "counter",
+    "remote_ps.client.bytes_sent": "counter",
+    "remote_ps.client.rtt_s": "histogram",
+    "remote_ps.server.auth_failures": "counter",
+    "remote_ps.server.bytes_received": "counter",
+    "remote_ps.server.dispatch": "counter",
+    "remote_ps.server.handle_s": "histogram",
+    "remote_ps.server.inflight_connections": "gauge",
+    # serving plane
+    "serving.batch_errors": "counter",
+    "serving.batch_size": "histogram",
+    "serving.batch_wait_s": "histogram",
+    "serving.batches": "counter",
+    "serving.compiles": "counter",
+    "serving.completed": "counter",
+    "serving.deadline_exceeded": "counter",
+    "serving.execute_s": "histogram",
+    "serving.oldest_request_age_s": "gauge",
+    "serving.padding_rows": "histogram",
+    "serving.queue_depth": "gauge",
+    "serving.rejected": "counter",
+    "serving.request_latency_s": "histogram",
+    "serving.server.auth_failures": "counter",
+    "serving.server.inflight_connections": "gauge",
+    "serving.server.requests": "counter",
+    "serving.submitted": "counter",
+    # trainer lifecycle
+    "trainer.training_time_s": "gauge",
+    # span names (the `with span("..."):` vocabulary; each also emits a
+    # `span.<name>.duration_s` histogram via the prefix family below)
+    "serving.compile": "span",
+    "serving.warmup": "span",
+    "trainer.compile": "span",
+    "trainer.epoch": "span",
+    "trainer.finalize": "span",
+    "trainer.init": "span",
+    "trainer.stage": "span",
+}
+
+#: Dynamic name families: any name starting with one of these prefixes is
+#: declared as a family with the given kind (same literal-dict contract as
+#: METRIC_NAMES).
+METRIC_PREFIXES = {
+    # per-span duration histograms minted by MetricsRegistry.record_span
+    "span.": "histogram",
+    # device memory stats keyed by whatever the backend reports
+    "observability.hbm_": "gauge",
+}
+
+
+def declared_kind(name: str):
+    """The registered kind for ``name`` ("counter" | "gauge" |
+    "histogram" | "span"), or None when the name is undeclared (ad-hoc
+    names are allowed; they are simply outside the registry's contract)."""
+    k = METRIC_NAMES.get(name)
+    if k is not None:
+        return k
+    for prefix, kind in METRIC_PREFIXES.items():
+        if name.startswith(prefix):
+            return kind
+    return None
 
 #: Per-thread-shard ring size for histograms. 1024 doubles (per writing
 #: thread) bounds memory while keeping p50/p95 meaningful for the window
@@ -269,6 +392,13 @@ class MetricsRegistry:
         key = (name, tuple(sorted(labels.items())))
         m = self._metrics.get(key)
         if m is None:
+            # the registry contract (METRIC_NAMES) is enforced on the
+            # creation path only — the hot path stays a bare dict hit
+            want = declared_kind(name)
+            if want is not None and want != cls.kind:
+                raise TypeError(
+                    f"metric {name!r} is declared as a {want} in "
+                    f"telemetry.METRIC_NAMES but requested as {cls.kind}")
             with self._create_lock:
                 m = self._metrics.get(key)
                 if m is None:

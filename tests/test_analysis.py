@@ -1,0 +1,571 @@
+"""dktlint fixture tests: every rule gets a known-bad snippet (true
+positive asserted) and a known-good snippet (no false positive), plus
+suppression semantics and the baseline round-trip (DESIGN.md §12)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from distkeras_tpu.analysis.core import (Finding, module_from_source,
+                                         run_suite, write_baseline)
+from distkeras_tpu.analysis.jit_purity import JitPurityChecker
+from distkeras_tpu.analysis.layering import LayeringChecker
+from distkeras_tpu.analysis.locks import LockDisciplineChecker
+from distkeras_tpu.analysis.registry import (PrecisionPinChecker,
+                                             TelemetryRegistryChecker)
+from distkeras_tpu.analysis.wire import Protocol, WireProtocolChecker
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _check(checker, *mods):
+    """Run one checker over source-string modules; return rule-name list."""
+    modules = [module_from_source(textwrap.dedent(src), rel)
+               for rel, src in mods]
+    return [f.rule for f in checker.check(modules)]
+
+
+# A minimal telemetry.py stand-in for registry fixtures: the checker reads
+# METRIC_NAMES/METRIC_PREFIXES from this module's AST.
+_TELEMETRY_STUB = ("distkeras_tpu/telemetry.py", """
+    METRIC_NAMES = {
+        "ps.commit.count": "counter",
+        "serving.queue_depth": "gauge",
+    }
+    METRIC_PREFIXES = {
+        "span.": "histogram",
+    }
+""")
+
+
+# -- jit purity --------------------------------------------------------------
+
+def test_jit_host_effect_bad():
+    rules = _check(JitPurityChecker(), ("distkeras_tpu/x.py", """
+        import time
+        import jax
+
+        @jax.jit
+        def step(params):
+            t0 = time.time()
+            return params, t0
+    """))
+    assert "jit-host-effect" in rules
+
+
+def test_jit_host_effect_nested_def_and_wrapped_name():
+    # the repo idiom: jax.jit(window_fn) with a nested one_step inside
+    rules = _check(JitPurityChecker(), ("distkeras_tpu/x.py", """
+        import jax
+        import numpy as np
+
+        def make(fn):
+            def window_fn(c, xs):
+                def one_step(c, x):
+                    return c, np.random.rand()
+                return jax.lax.scan(one_step, c, xs)
+            return jax.jit(window_fn)
+    """))
+    assert "jit-host-effect" in rules
+
+
+def test_jit_host_effect_good():
+    rules = _check(JitPurityChecker(), ("distkeras_tpu/x.py", """
+        import time
+        import jax
+        import jax.numpy as jnp
+
+        def host_probe():
+            return time.time()  # not traced: fine
+
+        @jax.jit
+        def step(params, key):
+            noise = jax.random.normal(key, (4,))
+            return jax.tree.map(lambda p: p + jnp.sum(noise), params)
+    """))
+    assert rules == []
+
+
+def test_jit_closure_mutation_bad_and_good():
+    bad = _check(JitPurityChecker(), ("distkeras_tpu/x.py", """
+        import jax
+        LOG = []
+
+        @jax.jit
+        def step(p):
+            LOG.append(1)
+            return p
+    """))
+    assert "jit-closure-mutation" in bad
+    # optax's pure tx.update(grads, state, params) must NOT be flagged
+    good = _check(JitPurityChecker(), ("distkeras_tpu/x.py", """
+        import jax
+
+        def make(tx):
+            @jax.jit
+            def step(p, g, s):
+                local = []
+                local.append(g)
+                updates, s = tx.update(g, s, p)
+                return updates, s
+            return step
+    """))
+    assert good == []
+
+
+def test_jit_tracer_branch_bad_static_good():
+    bad = _check(JitPurityChecker(), ("distkeras_tpu/x.py", """
+        import jax
+
+        @jax.jit
+        def step(x):
+            if x > 0:
+                return x
+            return -x
+    """))
+    assert "jit-tracer-branch" in bad
+    good = _check(JitPurityChecker(), ("distkeras_tpu/x.py", """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("training",))
+        def step(x, training):
+            if training:          # static arg: python branch is legal
+                return x
+            if x.ndim == 2:       # shape read: static under tracing
+                return x * 2
+            return -x
+    """))
+    assert good == []
+
+
+# -- locks -------------------------------------------------------------------
+
+_LOCK_BAD = ("distkeras_tpu/x.py", """
+    import threading
+
+    class S:
+        def __init__(self, sock):
+            self._lock = threading.Lock()
+            self._sock = sock
+
+        def send(self, payload):
+            with self._lock:
+                self._sock.sendall(payload)
+""")
+
+
+def test_lock_blocking_call_bad():
+    assert "lock-blocking-call" in _check(LockDisciplineChecker(),
+                                          _LOCK_BAD)
+
+
+def test_lock_blocking_call_good():
+    rules = _check(LockDisciplineChecker(), ("distkeras_tpu/x.py", """
+        import threading
+
+        class S:
+            def __init__(self, sock):
+                self._cv = threading.Condition()
+                self._sock = sock
+                self.items = []
+
+            def send(self, payload):
+                with self._cv:
+                    # waiting on the HELD cv releases it: not blocking
+                    self._cv.wait_for(lambda: bool(self.items))
+                    item = self.items.pop()
+                self._sock.sendall(item)  # outside the lock: fine
+    """))
+    assert rules == []
+
+
+def test_lock_order_cycle():
+    bad = _check(LockDisciplineChecker(), ("distkeras_tpu/x.py", """
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def fwd():
+            with A:
+                with B:
+                    pass
+
+        def rev():
+            with B:
+                with A:
+                    pass
+    """))
+    assert "lock-order-cycle" in bad
+    good = _check(LockDisciplineChecker(), ("distkeras_tpu/x.py", """
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def f1():
+            with A:
+                with B:
+                    pass
+
+        def f2():
+            with A:
+                with B:
+                    pass
+    """))
+    assert "lock-order-cycle" not in good
+
+
+# -- wire protocol -----------------------------------------------------------
+
+def _wire_checker():
+    return WireProtocolChecker(protocols=(Protocol(
+        name="demo",
+        server_paths=("distkeras_tpu/srv.py",),
+        client_paths=("distkeras_tpu/cli.py",)),))
+
+
+def test_wire_unhandled_op():
+    rules = _check(_wire_checker(),
+                   ("distkeras_tpu/srv.py", """
+        def dispatch(conn, header):
+            op = header.get("op")
+            if op == "pull":
+                pass
+    """),
+                   ("distkeras_tpu/cli.py", """
+        class C:
+            def pull(self):
+                return self._roundtrip({"op": "pull"})
+
+            def commit(self):
+                return self._roundtrip({"op": "comit"})  # typo
+    """))
+    assert "wire-unhandled-op" in rules
+
+
+def test_wire_unreferenced_op_and_clean():
+    rules = _check(_wire_checker(),
+                   ("distkeras_tpu/srv.py", """
+        def dispatch(conn, header):
+            op = header.get("op")
+            if op == "pull":
+                pass
+            elif op == "legacy_reset":
+                pass
+    """),
+                   ("distkeras_tpu/cli.py", """
+        class C:
+            def pull(self):
+                return self._roundtrip({"op": "pull"})
+    """))
+    assert "wire-unreferenced-op" in rules
+    clean = _check(_wire_checker(),
+                   ("distkeras_tpu/srv.py", """
+        OPS = ("pull", "commit")
+
+        def dispatch(conn, header):
+            op = header.get("op")
+            if op in OPS:
+                pass
+    """),
+                   ("distkeras_tpu/cli.py", """
+        class C:
+            def go(self):
+                self._roundtrip({"op": "pull"})
+                self._roundtrip({"op": "commit"})
+    """))
+    assert clean == []
+
+
+def test_wire_error_kind_drift_detected_on_repo_shape():
+    # the real serving module must declare ERROR_KINDS == emitted kinds;
+    # simulate drift by declaring a kind the server never emits
+    checker = WireProtocolChecker(protocols=())
+    mods = [module_from_source(textwrap.dedent("""
+        ERROR_KINDS = ("deadline", "ghost_kind")
+
+        def _error_kind(exc):
+            return "deadline"
+    """), "distkeras_tpu/serving/server.py")]
+    rules = [f.rule for f in checker.check(mods)]
+    assert "wire-error-kind-drift" in rules
+
+
+# -- telemetry registry ------------------------------------------------------
+
+def test_telemetry_undeclared_producer():
+    rules = _check(TelemetryRegistryChecker(), _TELEMETRY_STUB,
+                   ("distkeras_tpu/a.py", """
+        from distkeras_tpu import telemetry
+        telemetry.counter("ps.commit.cnt").inc()  # typo'd name
+    """))
+    assert "telemetry-undeclared-name" in rules
+
+
+def test_telemetry_kind_mismatch():
+    rules = _check(TelemetryRegistryChecker(), _TELEMETRY_STUB,
+                   ("distkeras_tpu/a.py", """
+        from distkeras_tpu import telemetry
+        telemetry.gauge("ps.commit.count").set(1)
+    """))
+    assert "telemetry-kind-mismatch" in rules
+
+
+def test_telemetry_consumer_drift():
+    rules = _check(TelemetryRegistryChecker(), _TELEMETRY_STUB,
+                   ("distkeras_tpu/health/export.py", """
+        def read(snapshot):
+            return snapshot["gauges"].get("serving.queue_depht")  # typo
+    """))
+    assert "telemetry-unknown-consumer-name" in rules
+
+
+def test_telemetry_clean_producers_and_consumers():
+    rules = _check(TelemetryRegistryChecker(), _TELEMETRY_STUB,
+                   ("distkeras_tpu/a.py", """
+        from distkeras_tpu import telemetry
+        telemetry.counter("ps.commit.count").inc()
+        telemetry.gauge("serving.queue_depth").set(0)
+        telemetry.histogram(f"span.x.duration_s").record(1.0)
+    """),
+                   ("distkeras_tpu/health/export.py", """
+        def read(snapshot):
+            return snapshot["gauges"].get("serving.queue_depth")
+    """))
+    assert rules == []
+
+
+# -- precision ---------------------------------------------------------------
+
+def test_precision_pin_bad_and_good():
+    bad = _check(PrecisionPinChecker(), ("distkeras_tpu/models/m.py", """
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        class M(nn.Module):
+            def __call__(self, x, dtype):
+                x = nn.LayerNorm()(x)                       # unpinned LN
+                x = nn.Dense(10, dtype=dtype, name="head")(x)
+                return x
+    """))
+    assert bad.count("precision-f32-pin") == 2
+    good = _check(PrecisionPinChecker(), ("distkeras_tpu/models/m.py", """
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+
+        class M(nn.Module):
+            def __call__(self, x, dtype):
+                x = nn.LayerNorm(dtype=jnp.float32)(x)
+                w = jax.nn.softmax(x, axis=-1).astype(dtype)  # output cast
+                x = nn.Dense(10, dtype=jnp.float32, name="head")(w)
+                return x
+    """))
+    assert good == []
+
+
+def test_precision_softmax_downcast_input():
+    bad = _check(PrecisionPinChecker(), ("distkeras_tpu/ops/a.py", """
+        import jax
+        import jax.numpy as jnp
+
+        def attn(logits, dtype):
+            return jax.nn.softmax(logits.astype(jnp.bfloat16), axis=-1)
+    """))
+    assert "precision-f32-pin" in bad
+
+
+# -- layering ----------------------------------------------------------------
+
+def test_layering_bad_and_good():
+    bad = _check(LayeringChecker(), ("distkeras_tpu/health/probe.py", """
+        import jax
+
+
+        def f():
+            return jax.devices()
+    """))
+    assert "layer-forbidden-import" in bad
+    # lazy imports are still imports
+    lazy = _check(LayeringChecker(), ("distkeras_tpu/health/probe.py", """
+        def f():
+            import jax
+            return jax.devices()
+    """))
+    assert "layer-forbidden-import" in lazy
+    good = _check(LayeringChecker(), ("distkeras_tpu/health/probe.py", """
+        import numpy as np
+
+
+        def f():
+            return np.zeros(3)
+    """))
+    assert good == []
+
+
+def test_layering_serving_trainers_and_models_parallel():
+    assert "layer-forbidden-import" in _check(
+        LayeringChecker(), ("distkeras_tpu/serving/s.py", """
+        from distkeras_tpu.trainers import DOWNPOUR
+    """))
+    assert "layer-forbidden-import" in _check(
+        LayeringChecker(), ("distkeras_tpu/models/m.py", """
+        from distkeras_tpu.parallel import substrate
+    """))
+
+
+# -- suppressions ------------------------------------------------------------
+
+def test_inline_suppression():
+    mod = module_from_source(textwrap.dedent("""
+        import threading
+
+        class S:
+            def __init__(self, sock):
+                self._lock = threading.Lock()
+                self._sock = sock
+
+            def send(self, payload):
+                with self._lock:
+                    self._sock.sendall(payload)  # dktlint: disable=lock-blocking-call
+    """), "distkeras_tpu/x.py")
+    findings = LockDisciplineChecker().check([mod])
+    assert findings and all(mod.is_suppressed(f) for f in findings)
+
+
+def test_standalone_comment_suppresses_next_line():
+    mod = module_from_source(textwrap.dedent("""
+        import threading
+
+        class S:
+            def __init__(self, sock):
+                self._lock = threading.Lock()
+                self._sock = sock
+
+            def send(self, payload):
+                with self._lock:
+                    # dktlint: disable=lock-blocking-call
+                    self._sock.sendall(payload)
+    """), "distkeras_tpu/x.py")
+    findings = LockDisciplineChecker().check([mod])
+    assert findings and all(mod.is_suppressed(f) for f in findings)
+
+
+def test_suppression_is_rule_scoped():
+    mod = module_from_source(textwrap.dedent("""
+        import threading
+
+        class S:
+            def __init__(self, sock):
+                self._lock = threading.Lock()
+                self._sock = sock
+
+            def send(self, payload):
+                with self._lock:
+                    self._sock.sendall(payload)  # dktlint: disable=some-other-rule
+    """), "distkeras_tpu/x.py")
+    findings = LockDisciplineChecker().check([mod])
+    assert findings and not any(mod.is_suppressed(f) for f in findings)
+
+
+def test_file_level_suppression():
+    mod = module_from_source(textwrap.dedent("""
+        # dktlint: disable-file=layer-forbidden-import
+        import jax
+    """), "distkeras_tpu/health/probe.py")
+    findings = LayeringChecker().check([mod])
+    assert findings and all(mod.is_suppressed(f) for f in findings)
+
+
+# -- baseline round-trip -----------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    src_dir = tmp_path / "distkeras_tpu" / "health"
+    src_dir.mkdir(parents=True)
+    (tmp_path / "pyproject.toml").write_text("[project]\n")
+    bad = "import jax\n"
+    (src_dir / "probe.py").write_text(bad)
+
+    checkers = [LayeringChecker()]
+    report = run_suite(str(tmp_path), checkers=checkers)
+    assert [f.rule for f in report.findings] == ["layer-forbidden-import"]
+
+    # accept into the baseline: the same finding no longer fails the run
+    baseline = tmp_path / ".dktlint-baseline.json"
+    from distkeras_tpu.analysis.core import collect_modules
+    mods = {m.relpath: m for m in collect_modules(str(tmp_path))}
+    write_baseline(str(baseline), report.findings, mods)
+    again = run_suite(str(tmp_path), checkers=checkers,
+                      baseline_path=str(baseline))
+    assert again.findings == [] and len(again.baselined) == 1
+
+    # a NEW finding still fails despite the baseline
+    (src_dir / "probe.py").write_text(bad + "import flax\n")
+    third = run_suite(str(tmp_path), checkers=checkers,
+                      baseline_path=str(baseline))
+    assert len(third.findings) == 1
+    assert "flax" in third.findings[0].message
+
+    data = json.loads(baseline.read_text())
+    assert data["version"] == 1 and len(data["fingerprints"]) == 1
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def test_cli_exits_nonzero_on_bad_tree(tmp_path):
+    (tmp_path / "pyproject.toml").write_text("[project]\n")
+    pkg = tmp_path / "distkeras_tpu" / "health"
+    pkg.mkdir(parents=True)
+    (pkg / "probe.py").write_text("import jax\n")
+    from distkeras_tpu.analysis.__main__ import main
+    assert main(["--root", str(tmp_path), "--no-baseline"]) == 1
+
+
+def test_cli_exits_zero_on_clean_tree(tmp_path):
+    (tmp_path / "pyproject.toml").write_text("[project]\n")
+    pkg = tmp_path / "distkeras_tpu"
+    pkg.mkdir()
+    (pkg / "ok.py").write_text("import numpy as np\n")
+    from distkeras_tpu.analysis.__main__ import main
+    assert main(["--root", str(tmp_path), "--no-baseline"]) == 0
+
+
+def test_cli_write_baseline_then_clean(tmp_path):
+    (tmp_path / "pyproject.toml").write_text("[project]\n")
+    pkg = tmp_path / "distkeras_tpu" / "health"
+    pkg.mkdir(parents=True)
+    (pkg / "probe.py").write_text("import jax\n")
+    from distkeras_tpu.analysis.__main__ import main
+    assert main(["--root", str(tmp_path), "--write-baseline"]) == 0
+    assert main(["--root", str(tmp_path)]) == 0  # baselined, not failing
+
+
+def test_cli_list_rules_names_every_rule():
+    from distkeras_tpu.analysis.__main__ import main
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert main(["--list-rules"]) == 0
+    text = buf.getvalue()
+    for rule in ("jit-host-effect", "jit-closure-mutation",
+                 "jit-tracer-branch", "lock-blocking-call",
+                 "lock-order-cycle", "wire-unhandled-op",
+                 "wire-unreferenced-op", "wire-error-kind-drift",
+                 "telemetry-undeclared-name", "telemetry-kind-mismatch",
+                 "telemetry-unknown-consumer-name", "precision-f32-pin",
+                 "layer-forbidden-import"):
+        assert rule in text, rule
+
+
+def test_module_invocation_smoke():
+    """`python -m distkeras_tpu.analysis` is the documented entry point."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "distkeras_tpu.analysis", "--list-rules"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "lock-blocking-call" in proc.stdout
