@@ -1,0 +1,167 @@
+"""Probe the elastic fleet: sharded-PS throughput under injected churn.
+
+The end-to-end demo of DESIGN.md §13: start a small DynSGD host-async run
+against a loopback N-shard
+:class:`~distkeras_tpu.parallel.remote_ps.ParameterServerService` fleet,
+first clean (baseline windows/s), then again with scripted transport
+chaos armed mid-run — a connection reset after the bytes leave (the
+commit-dedup scenario), a reset before they leave (plain reconnect), and
+a shard stall (per-op timeout → retry). Prints both throughputs and the
+fault-path counters that prove the churn actually exercised reconnect,
+dedup, and retry rather than timing luck.
+
+Usage:
+  python benchmarks/elastic_probe.py [--shards 2] [--workers 4]
+                                     [--epochs 2] [--no-chaos]
+
+CPU-safe: the model is the baseline MNIST MLP on synthetic data.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import secrets
+import sys
+import time
+
+try:
+    import distkeras_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # running from a source checkout: use the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+#: telemetry counter prefixes that tell the churn story, in print order
+FAULT_COUNTERS = (
+    "fault.chaos",
+    "remote_ps.client.reconnects",
+    "remote_ps.client.retries",
+    "remote_ps.client.unavailable",
+    "remote_ps.server.dedup_hits",
+    "host_async.degraded_windows",
+    "elastic.evictions",
+    "elastic.readmissions",
+    "elastic.late_folds",
+)
+
+
+def _counter_totals(snapshot: dict) -> dict:
+    """Sum each FAULT_COUNTERS series over its labels."""
+    totals = {name: 0 for name in FAULT_COUNTERS}
+    for key, value in snapshot["counters"].items():
+        base = key.split("{", 1)[0]
+        if base in totals:
+            totals[base] += int(value)
+    return totals
+
+
+def run_probe(n: int = 2048, shards: int = 2, workers: int = 4,
+              window: int = 4, batch: int = 16, epochs: int = 2,
+              chaos: bool = True) -> dict:
+    """One training run against a loopback shard fleet; returns
+    ``{"seconds", "windows", "windows_per_s", "counters", "membership"}``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distkeras_tpu import DynSGD, synthetic_mnist, telemetry
+    from distkeras_tpu.comms import RetryPolicy
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.parallel import elastic, host_async
+    from distkeras_tpu.utils import fault
+
+    model = MLP(features=(32,), num_classes=10)
+    # the trainer is only the convenient factory for (tx, strategy)
+    t = DynSGD(model, mode="host_async", num_workers=workers,
+               worker_optimizer="sgd", learning_rate=0.05, metrics=(),
+               batch_size=batch, communication_window=window)
+    ds = synthetic_mnist(n=n)
+    staged = host_async.stage_worker_shards(
+        ds.repartition(workers), "features", "label", batch, window)
+    params = model.init(jax.random.key(0), jnp.zeros((batch, 784)),
+                        train=False)["params"]
+    runner = host_async.HostAsyncRunner(
+        model, "categorical_crossentropy", t.tx, t.strategy, window=window)
+
+    def make_ps(part):
+        return host_async.server_for(t.strategy,
+                                     jax.device_put(part,
+                                                    runner.devices[0]))
+
+    token = secrets.token_hex(16)
+    services = elastic.make_ps_fleet(make_ps, params, shards, token=token)
+    client = elastic.ShardedRemoteParameterServer(
+        [f"127.0.0.1:{svc.port}" for svc in services], params, token=token,
+        retry=RetryPolicy(max_retries=6, base_s=0.02, max_s=0.25),
+        op_timeout=10.0)
+    if chaos:
+        # budgets let the run warm up, then hit every distinct fault path
+        fault.inject_chaos("remote_ps.send", "reset_after_send",
+                           after=workers + 1, count=1)
+        fault.inject_chaos("remote_ps.server.handle", "reset",
+                           after=3 * workers, count=1)
+    before = _counter_totals(telemetry.reset().snapshot())
+    t0 = time.perf_counter()
+    try:
+        runner.run(params, [staged] * epochs, ps=client)
+        if chaos:
+            # mid-probe stall: arm, then push one more epoch through it
+            fault.inject_chaos("remote_ps.server.handle", "delay",
+                               delay_s=0.2, count=2)
+            runner.run(params, [staged], ps=client,
+                       start_clock=client.num_updates)
+        dt = time.perf_counter() - t0
+        membership = services[0].membership.status() \
+            if services[0].membership else {}
+    finally:
+        fault.clear_chaos()
+        client.close()
+        for svc in services:
+            svc.stop()
+    snap = telemetry.get_registry().snapshot() \
+        if telemetry.get_registry() else {"counters": {}}
+    totals = _counter_totals(snap)
+    counters = {k: totals[k] - before.get(k, 0) for k in totals}
+    run_epochs = epochs + (1 if chaos else 0)
+    windows = run_epochs * sum(len(rounds) for rounds in staged)
+    return {"seconds": dt, "windows": windows,
+            "windows_per_s": windows / dt, "counters": counters,
+            "membership": membership}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="throughput + fault-counter probe of the sharded "
+                    "elastic parameter-server fleet")
+    ap.add_argument("--n", type=int, default=2048, help="dataset rows")
+    ap.add_argument("--shards", type=int, default=2)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--window", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--no-chaos", action="store_true",
+                    help="skip the churn leg (clean baseline only)")
+    args = ap.parse_args(argv)
+
+    clean = run_probe(n=args.n, shards=args.shards, workers=args.workers,
+                      window=args.window, batch=args.batch,
+                      epochs=args.epochs, chaos=False)
+    print(f"clean : {args.shards} shard(s), {args.workers} workers: "
+          f"{clean['windows']} windows in {clean['seconds']:.2f}s "
+          f"({clean['windows_per_s']:.1f} windows/s)")
+    if args.no_chaos:
+        return
+    churn = run_probe(n=args.n, shards=args.shards, workers=args.workers,
+                      window=args.window, batch=args.batch,
+                      epochs=args.epochs, chaos=True)
+    print(f"churn : {churn['windows']} windows in "
+          f"{churn['seconds']:.2f}s ({churn['windows_per_s']:.1f} "
+          f"windows/s)")
+    for name, value in churn["counters"].items():
+        print(f"  {name}: {value}")
+    if churn["membership"]:
+        print(f"  membership: {churn['membership']}")
+
+
+if __name__ == "__main__":
+    main()

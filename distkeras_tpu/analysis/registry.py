@@ -49,7 +49,8 @@ _CONSUMER_PATHS = (
     "distkeras_tpu/health/export.py",
     "distkeras_tpu/health/endpoints.py",
 )
-_FAULT_FUNCS = {"inject", "apply", "clear_injections"}
+_FAULT_FUNCS = {"inject", "apply", "clear_injections",
+                "inject_chaos", "chaos", "clear_chaos"}
 
 
 def _literal_dict(tree: ast.AST, name: str) -> Dict[str, str]:

@@ -52,8 +52,13 @@ PROTOCOLS: Tuple[Protocol, ...] = (
     # lives in health/endpoints.py — it is a client of every server
     Protocol(
         name="remote_ps",
+        # elastic.py drives the shard fleet through RemoteParameterServer
+        # method calls today, but it is a client of this protocol — listed
+        # so any op dict it grows (register/lease_renew/deregister/
+        # shard_map fan-out) is checked against the server dispatch
         server_paths=("distkeras_tpu/parallel/remote_ps.py",),
         client_paths=("distkeras_tpu/parallel/remote_ps.py",
+                      "distkeras_tpu/parallel/elastic.py",
                       "distkeras_tpu/health/endpoints.py"),
     ),
     Protocol(

@@ -26,10 +26,12 @@ from distkeras_tpu.comms.codec import (
     get_codec,
     negotiate,
 )
+from distkeras_tpu.comms.retry import DEFAULT_RETRY, RetryPolicy
 
 __all__ = [
     "Codec", "RawCodec", "Fp16Codec", "Bf16Codec", "QuantCodec",
     "ErrorFeedback", "EncodedParameterServer",
     "get_codec", "available_codecs", "negotiate",
     "leaf_buffer", "iter_chunks", "send_buffers", "DEFAULT_CHUNK_BYTES",
+    "RetryPolicy", "DEFAULT_RETRY",
 ]

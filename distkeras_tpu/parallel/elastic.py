@@ -1,0 +1,238 @@
+"""Sharded parameter-server fleet: split the center, survive the churn.
+
+DESIGN.md §6 called the single ParameterServerService the honest
+limitation of the cross-process path: one process, one socket, the whole
+center pytree through one NIC. This module removes it (DESIGN.md §13):
+
+- :func:`shard_assignment` splits the center's LEAVES over N shards with
+  a deterministic size-balanced greedy packing — every process computes
+  the identical map from its own (identically-initialized) params, so the
+  map never travels;
+- each shard is an ordinary :class:`ParameterServerService` over an
+  ordinary ParameterServer holding just its leaf subset (a python list of
+  leaves IS a pytree — the codec/chunking/auth stack is reused unchanged,
+  and N=1 is wire-identical to the single-server protocol);
+- :class:`ShardedRemoteParameterServer` fans pull/commit out in parallel
+  and reassembles, presenting the same ParameterServer interface, so
+  HostAsyncRunner cannot tell a fleet from a single server.
+
+Consistency model (the paper's, made explicit): shard 0 is the
+**coordinator** — its clock is the authority a pull reports and the
+membership/lease/history plane lives there. A logical commit folds on
+the coordinator FIRST; the coordinator's reply carries the applied fold
+weight, and every follower shard folds the same commit with that exact
+explicit weight — so a DynSGD fold scales identically on all shards even
+though their local clocks never talk to each other. A pull reads shards
+concurrently and may observe a commit on one shard before another (a
+torn read); under ASYNCHRONOUS SGD that is one more staleness
+perturbation of the same kind the algorithm already absorbs, and it
+vanishes at the quiescent points where equality matters (history
+barrier, final center).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import time
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from distkeras_tpu.health.heartbeat import StragglerDetector
+from distkeras_tpu.health.membership import DEFAULT_LEASE_S, Membership
+from distkeras_tpu.parallel.remote_ps import (
+    ParameterServerService,
+    RemoteParameterServer,
+)
+from distkeras_tpu.utils.fetch import device_get_batched
+
+
+def shard_assignment(like: Any, num_shards: int) -> list:
+    """Deterministic size-balanced leaf→shard map: greedy longest-
+    processing-time packing (leaves by descending byte size, each to the
+    currently lightest shard; all ties broken by index, so every process
+    computes the same map). Returns ``num_shards`` sorted index lists.
+    """
+    leaves = jax.tree_util.tree_flatten(like)[0]
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    if num_shards > len(leaves):
+        raise ValueError(
+            f"cannot split {len(leaves)} leaves over {num_shards} shards "
+            f"(a shard would hold no parameters)")
+    sizes = [int(np.prod(np.shape(l)) * np.dtype(
+        getattr(l, "dtype", np.float32)).itemsize) for l in leaves]
+    order = sorted(range(len(leaves)), key=lambda i: (-sizes[i], i))
+    loads = [0] * num_shards
+    shards: list = [[] for _ in range(num_shards)]
+    for i in order:
+        s = min(range(num_shards), key=lambda j: (loads[j], j))
+        shards[s].append(i)
+        loads[s] += sizes[i]
+    return [sorted(s) for s in shards]
+
+
+def split_tree(tree: Any, assignment: Sequence[Sequence[int]]) -> list:
+    """The tree's leaves regrouped per shard (each group is itself a
+    pytree — a python list — so the per-shard codec stack is unchanged)."""
+    leaves = jax.tree_util.tree_flatten(tree)[0]
+    return [[leaves[i] for i in idxs] for idxs in assignment]
+
+
+def join_tree(parts: Sequence[Sequence], assignment, treedef) -> Any:
+    """Inverse of :func:`split_tree`: reassemble the full pytree."""
+    leaves: list = [None] * sum(len(idxs) for idxs in assignment)
+    for part, idxs in zip(parts, assignment):
+        for leaf, i in zip(part, idxs):
+            leaves[i] = leaf
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def make_ps_fleet(ps_factory: Callable[[Any], Any], like: Any,
+                  num_shards: int, expected_processes: int = 1,
+                  host: str = "0.0.0.0", token: Optional[str] = None,
+                  codecs: Optional[Sequence[str]] = None,
+                  advertise_host: str = "127.0.0.1",
+                  lease_s: float = DEFAULT_LEASE_S,
+                  straggler: Optional[StragglerDetector] = None,
+                  time_fn: Callable[[], float] = time.time) -> list:
+    """Construct and start N shard services on this host.
+
+    ``ps_factory`` builds the server flavor for one shard's leaf list
+    (e.g. ``DynSGDParameterServer``). Shard 0 gets the membership plane
+    (leases + straggler-driven eviction); followers hold only leaves.
+    Every service is started and knows the full fleet map
+    (``shard_addresses``), so any shard can bootstrap a late joiner.
+    """
+    assignment = shard_assignment(like, num_shards)
+    parts = split_tree(like, assignment)
+    services = []
+    for shard, part in enumerate(parts):
+        membership = Membership(lease_s=lease_s, straggler=straggler,
+                                time_fn=time_fn) if shard == 0 else None
+        services.append(ParameterServerService(
+            ps_factory(part), part, expected_processes=expected_processes,
+            host=host, token=token, codecs=codecs, membership=membership,
+            shard=shard, num_shards=num_shards))
+    addresses = [f"{advertise_host}:{svc.port}" for svc in services]
+    for svc in services:
+        svc.shard_addresses = addresses
+        svc.start()
+    return services
+
+
+class ShardedRemoteParameterServer:
+    """Client for a shard fleet — a drop-in for the ParameterServer
+    interface, exactly like :class:`RemoteParameterServer` is for one
+    server (which is also what this degenerates to at N=1, one object
+    deep).
+
+    Pulls and follower commits fan out on a small thread pool; commit
+    identity (one ``(cid, seq)`` per LOGICAL commit, shared by all its
+    shard legs and all their retries) comes from the coordinator client,
+    so a retried multi-shard commit dedups per shard and folds once
+    everywhere.
+    """
+
+    elastic = True
+
+    def __init__(self, addresses: Sequence[str], like: Any,
+                 timeout: float = 600.0, token: Optional[str] = None,
+                 codec: str = "raw", retry=None,
+                 op_timeout: Optional[float] = None):
+        addresses = list(addresses)
+        if not addresses:
+            raise ValueError("need at least one shard address")
+        self.assignment = shard_assignment(like, len(addresses))
+        host_tree = jax.tree.map(np.asarray, device_get_batched(like))
+        self._treedef = jax.tree_util.tree_flatten(host_tree)[1]
+        parts = split_tree(host_tree, self.assignment)
+        self.clients = [
+            RemoteParameterServer(addr, part, timeout=timeout, token=token,
+                                  codec=codec, retry=retry,
+                                  op_timeout=op_timeout)
+            for addr, part in zip(addresses, parts)]
+        for client in self.clients[1:]:
+            client.cid = self.clients[0].cid  # one commit identity
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(4, 2 * len(self.clients)),
+            thread_name_prefix="ps-shard")
+
+    @property
+    def coordinator(self) -> RemoteParameterServer:
+        return self.clients[0]
+
+    # -- ParameterServer interface ----------------------------------------
+    def pull(self):
+        futures = [self._pool.submit(c.pull) for c in self.clients]
+        results = [f.result() for f in futures]
+        # clock authority is the coordinator; follower clocks only order
+        # their own folds (see the torn-read note in the module docstring)
+        return (join_tree([r[0] for r in results], self.assignment,
+                          self._treedef), results[0][1])
+
+    def commit(self, delta: Any, last_update: int = 0, **kw) -> int:
+        return self.commit_ex(delta, last_update=last_update, **kw)[0]
+
+    def commit_ex(self, delta: Any, last_update: int = 0, weight=None,
+                  seq: Optional[int] = None, worker: Optional[int] = None,
+                  window_s: Optional[float] = None) -> tuple:
+        parts = split_tree(delta, self.assignment)
+        if seq is None:
+            seq = self.clients[0].next_seq()
+        # coordinator first: its fold fixes the authoritative weight (and
+        # runs the membership plane — late folds, lease renewal); every
+        # follower then folds the same commit at that explicit weight
+        at_fold, applied = self.clients[0].commit_ex(
+            parts[0], last_update=last_update, weight=weight, seq=seq,
+            worker=worker, window_s=window_s)
+        futures = [
+            self._pool.submit(c.commit_ex, part, last_update, applied, seq)
+            for c, part in zip(self.clients[1:], parts[1:])]
+        for f in futures:
+            f.result()
+        return at_fold, applied
+
+    @property
+    def num_updates(self) -> int:
+        return self.clients[0].num_updates
+
+    # membership/history live on the coordinator shard
+    def register(self, worker: int,
+                 lease_s: Optional[float] = None) -> float:
+        return self.clients[0].register(worker, lease_s=lease_s)
+
+    def renew_lease(self, worker: int) -> bool:
+        return self.clients[0].renew_lease(worker)
+
+    def deregister(self, worker: int) -> None:
+        self.clients[0].deregister(worker)
+
+    def shard_map(self) -> dict:
+        return self.clients[0].shard_map()
+
+    def put_history(self, pid: int, windows: list) -> None:
+        self.clients[0].put_history(pid, windows)
+
+    def get_history(self, timeout: float = 600):
+        # the barrier (and merged history, and final clock) live on the
+        # coordinator; the fleet is quiescent once it resolves, so the
+        # follower pulls below read a settled center
+        windows, part0, clock = self.clients[0].get_history(timeout=timeout)
+        futures = [self._pool.submit(c.pull) for c in self.clients[1:]]
+        parts = [part0] + [f.result()[0] for f in futures]
+        return (windows, join_tree(parts, self.assignment, self._treedef),
+                clock)
+
+    def close(self) -> None:
+        for client in self.clients:
+            client.close()  # idempotent, bounded
+        self._pool.shutdown(wait=False)
+
+    # reference lifecycle no-ops (parity with ParameterServer)
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass

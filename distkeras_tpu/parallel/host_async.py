@@ -44,7 +44,13 @@ from distkeras_tpu.parameter_servers import (
     DynSGDParameterServer,
     ParameterServer,
 )
+from distkeras_tpu.parallel.remote_ps import PSUnavailable
 from distkeras_tpu.parallel.strategies import Strategy
+
+
+def _tree_add(a, b):
+    """Leafwise sum — the degradation ladder's backlog accumulator."""
+    return jax.tree.map(lambda x, y: x + y, a, b)
 
 
 def server_for(strategy: Strategy, params) -> ParameterServer:
@@ -159,9 +165,15 @@ class HostAsyncRunner:
                  metrics: Sequence[str] = (), seed: int = 0,
                  devices: Optional[Sequence[jax.Device]] = None,
                  codec: Optional[str] = None, overlap: bool = False,
-                 accum_steps: int = 1, precision: Optional[str] = None):
+                 accum_steps: int = 1, precision: Optional[str] = None,
+                 max_degraded_windows: int = 16):
         self.strategy = strategy
         self.window = int(window)
+        # degradation ladder budget (DESIGN.md §13): how many consecutive
+        # compute-only windows a worker rides out against an unreachable
+        # fleet (stale center, commits accumulated locally) before the
+        # outage is surfaced as the underlying PSUnavailable
+        self.max_degraded_windows = int(max_degraded_windows)
         self.accum_steps = int(accum_steps)
         self.window_fn = make_window_fn(model, loss, tx, strategy, window,
                                         tuple(metrics), seed,
@@ -327,29 +339,29 @@ class HostAsyncRunner:
                             float(np.mean(ms["loss"]))))
                         watchdog.notify_progress()
 
+                elastic = getattr(ps, "elastic", False)
+                if elastic:
+                    try:
+                        # join the fleet (lease on the coordinator shard);
+                        # best-effort — a commit is also an implicit join
+                        ps.register(wid)
+                    except Exception:
+                        pass
                 if self.overlap:
                     self._overlapped_rounds(
                         k, wid, dev, carry, ps, staged_rounds(), abort,
                         bookkeep, pull_h, win_h, commit_h)
-                    return
-                fold = 0
-                for batches in prefetch(staged_rounds(), depth=1):
-                    if abort.is_set():
-                        return  # a sibling died: stop wasting windows
-                    t0 = time.perf_counter()
-                    center, clock = ps.pull()
-                    t1 = time.perf_counter()
-                    pull_h.record(t1 - t0)
-                    carry, commit, ms = self.window_fn(
-                        carry, jax.device_put(center, dev), batches,
-                        np.int32(wid * 1_000_003 + fold))
-                    jax.block_until_ready(commit)
-                    t2 = time.perf_counter()
-                    win_h.record(t2 - t1)
-                    clock_at_fold = ps.commit(commit, last_update=clock)
-                    commit_h.record(time.perf_counter() - t2)
-                    bookkeep(clock_at_fold, clock, ms, t2 - t1)
-                    fold += 1
+                else:
+                    self._serial_rounds(
+                        k, wid, dev, carry, ps, elastic, staged_rounds(),
+                        abort, bookkeep, pull_h, win_h, commit_h)
+                if elastic:
+                    try:
+                        # clean leave — a crashed worker never gets here,
+                        # and the lease sweep evicts it instead
+                        ps.deregister(wid)
+                    except Exception:
+                        pass
             except Exception as e:  # surface thread failures to the caller
                 if e not in errors:  # a watchdog on_trip may have filed it
                     errors.append(e)
@@ -415,6 +427,82 @@ class HostAsyncRunner:
         center, _ = base_ps.pull()
         return device_get_batched(center), history, stal, ps.num_updates
 
+    def _serial_rounds(self, k, wid, dev, carry, ps, elastic, rounds,
+                       abort, bookkeep, pull_h, win_h, commit_h):
+        """The serialized pull → window → commit loop, with the elastic
+        degradation ladder (DESIGN.md §13): when the fleet is unreachable
+        (typed PSUnavailable after the transport's own retries), the
+        worker degrades to compute-only windows — it keeps training
+        against its last good center and accumulates the unfolded commits
+        locally — then folds the combined backlog in one commit when the
+        fleet returns. ``last_update`` of that fold is the OLDEST backlog
+        window's pull clock, so the server charges the honest staleness
+        (and DynSGD down-weights accordingly). Bookkeeping for backlog
+        windows is deferred until their fold clock exists. Bounded by
+        ``max_degraded_windows``; the final backlog (if the run ends
+        degraded) gets one last flush attempt before the error surfaces.
+        """
+        fold = 0
+        degraded = 0        # consecutive windows without a landed commit
+        backlog = None      # accumulated unfolded commit deltas
+        backlog_clock = 0   # pull clock of the OLDEST unfolded window
+        deferred: list = []  # (pull_clock, ms, win_s) awaiting a fold clock
+        last_center = None  # last successfully pulled (center, clock)
+        for batches in prefetch(rounds, depth=1):
+            if abort.is_set():
+                return  # a sibling died: stop wasting windows
+            t0 = time.perf_counter()
+            try:
+                center, clock = ps.pull()
+                last_center = (center, clock)
+            except PSUnavailable:
+                if last_center is None:
+                    raise  # never reached the fleet at all: a real error
+                center, clock = last_center  # compute-only: stale center
+            t1 = time.perf_counter()
+            pull_h.record(t1 - t0)
+            carry, commit, ms = self.window_fn(
+                carry, jax.device_put(center, dev), batches,
+                np.int32(wid * 1_000_003 + fold))
+            jax.block_until_ready(commit)
+            t2 = time.perf_counter()
+            win_s = t2 - t1
+            win_h.record(win_s)
+            to_send, last_up = commit, clock
+            if backlog is not None:
+                to_send = _tree_add(backlog, commit)
+                last_up = backlog_clock
+            try:
+                if elastic:
+                    clock_at_fold = ps.commit(to_send, last_update=last_up,
+                                              worker=wid, window_s=win_s)
+                else:
+                    clock_at_fold = ps.commit(to_send, last_update=last_up)
+            except PSUnavailable:
+                degraded += 1
+                telemetry.counter("host_async.degraded_windows",
+                                  worker=wid).inc()
+                if degraded > self.max_degraded_windows:
+                    raise
+                backlog, backlog_clock = to_send, last_up
+                deferred.append((clock, ms, win_s))
+                fold += 1
+                continue
+            commit_h.record(time.perf_counter() - t2)
+            degraded = 0
+            backlog = None
+            for d_clock, d_ms, d_win_s in deferred:
+                bookkeep(clock_at_fold, d_clock, d_ms, d_win_s)
+            deferred.clear()
+            bookkeep(clock_at_fold, clock, ms, win_s)
+            fold += 1
+        if backlog is not None:
+            # the run ended degraded: one last flush so the backlogged
+            # windows are not silently dropped from the center/history
+            clock_at_fold = ps.commit(backlog, last_update=backlog_clock)
+            for d_clock, d_ms, d_win_s in deferred:
+                bookkeep(clock_at_fold, d_clock, d_ms, d_win_s)
+
     def _overlapped_rounds(self, k, wid, dev, carry, ps, rounds, abort,
                            bookkeep, pull_h, win_h, commit_h):
         """Double-buffered worker loop: while window n computes, a
@@ -430,7 +518,15 @@ class HostAsyncRunner:
         clock pair, so the histogram reflects the extra self-staleness
         rather than hiding it; CadenceTrigger still fires on true fold
         clocks (one window later in this worker's observation stride).
+
+        Elastic note: this path gets the transport's reconnect/retry and
+        stamps worker identity (lease renewal), but NOT the compute-only
+        degradation ladder — the double-buffered hand-off has no place to
+        park a backlog without stalling the compute loop it exists to
+        keep busy. An outage longer than the retry budget surfaces as
+        PSUnavailable; use the serialized loop for churn-heavy fleets.
         """
+        elastic = getattr(ps, "elastic", False)
         _STOP = object()
         req: queue_lib.Queue = queue_lib.Queue(maxsize=1)
         resp: queue_lib.Queue = queue_lib.Queue(maxsize=1)
@@ -448,8 +544,12 @@ class HostAsyncRunner:
                     clock_at_fold = -1
                     if commit is not None:
                         t0 = time.perf_counter()
-                        clock_at_fold = ps.commit(commit,
-                                                  last_update=pull_clock)
+                        if elastic:
+                            clock_at_fold = ps.commit(
+                                commit, last_update=pull_clock, worker=wid)
+                        else:
+                            clock_at_fold = ps.commit(commit,
+                                                      last_update=pull_clock)
                         commit_h.record(time.perf_counter() - t0)
                     t0 = time.perf_counter()
                     center, clock = ps.pull()
@@ -501,7 +601,7 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
                       checkpoint_folds: int = 0, start_clock: int = 0,
                       service_port: int = 0,
                       history_timeout: float = 600.0,
-                      watchdog=None) -> tuple:
+                      watchdog=None, ps_shards: int = 1) -> tuple:
     """Pod-scale TRUE-async: this process's worker threads against ONE live
     center owned by process 0 (VERDICT r4 ask #2 — the reference's
     workers-on-separate-machines semantics).
@@ -520,13 +620,28 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
     path's process-transparency. Checkpointing runs only on process 0
     (it owns the center; snapshot cadence is evaluated at its workers'
     commit clocks, which carry the global count).
+
+    ``ps_shards > 1`` replaces the single service with an elastic fleet
+    (parallel/elastic.py): process 0 hosts N shard services (the center's
+    leaves size-balanced across them, shard 0 carrying the membership/
+    lease/history plane), the address broadcast carries the whole shard
+    map, and EVERY process's workers — including process 0's, which give
+    up the no-loopback-tax direct path — go through a
+    ShardedRemoteParameterServer, so the whole fleet is on the membership
+    plane and churn handling is uniform.
     """
     from jax.experimental import multihost_utils
 
+    from distkeras_tpu.parallel import elastic as elastic_mod
     from distkeras_tpu.parallel import remote_ps as rps
 
+    ps_shards = int(ps_shards)
+    if ps_shards < 1:
+        raise ValueError(f"ps_shards must be >= 1, got {ps_shards}")
     pid = jax.process_index()
+    codec_name = "raw" if runner.codec is None else runner.codec.name
     service = client = None
+    services: list = []
     try:
         if pid == 0:
             # symmetric go/no-go (ADVICE r5): if service construction fails
@@ -536,33 +651,72 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
                 import secrets
 
                 token = secrets.token_hex(16)
-                ps = server_for(
-                    runner.strategy,
-                    jax.device_put(init_params, runner.devices[0]))
-                ps.num_updates = int(start_clock)
-                service = rps.ParameterServerService(
-                    ps, init_params, expected_processes=jax.process_count(),
-                    port=service_port, token=token)
-                service.start()
+
+                def _make_ps(part):
+                    ps = server_for(
+                        runner.strategy,
+                        jax.device_put(part, runner.devices[0]))
+                    ps.num_updates = int(start_clock)
+                    return ps
+
+                if ps_shards == 1:
+                    ps = _make_ps(init_params)
+                    service = rps.ParameterServerService(
+                        ps, init_params,
+                        expected_processes=jax.process_count(),
+                        port=service_port, token=token)
+                    service.start()
+                    ports: Any = service.port
+                else:
+                    # a fresh detector: the services see worker-stamped
+                    # window durations from every process, the runner's
+                    # own detector only this process's — mixing the two
+                    # feeds would double-count local workers
+                    advertise = "127.0.0.1"
+                    if jax.process_count() > 1:
+                        from distkeras_tpu.parallel.distributed import \
+                            determine_host_address
+                        advertise = determine_host_address()
+                    services = elastic_mod.make_ps_fleet(
+                        _make_ps, init_params, ps_shards,
+                        expected_processes=jax.process_count(),
+                        token=token, straggler=StragglerDetector(),
+                        advertise_host=advertise)
+                    ports = [svc.port for svc in services]
             except Exception:
                 rps.share_service_address(None, error=True)
                 raise
-            rps.share_service_address(service.port, token=token)
-            local_ps = ps
-            if runner.codec is not None and runner.codec.name != "raw":
-                # process 0's workers skip the socket but must see the
-                # SAME wire numerics as remote peers, or convergence
-                # depends on which process a worker landed on
-                local_ps = comms.EncodedParameterServer(ps, runner.codec)
+            addr, _ = rps.share_service_address(ports, token=token)
+            if ps_shards == 1:
+                local_ps = ps
+                if runner.codec is not None and runner.codec.name != "raw":
+                    # process 0's workers skip the socket but must see the
+                    # SAME wire numerics as remote peers, or convergence
+                    # depends on which process a worker landed on
+                    local_ps = comms.EncodedParameterServer(ps, runner.codec)
+            else:
+                # loopback sharded client: process 0's workers join the
+                # same membership plane as everyone else's
+                client = elastic_mod.ShardedRemoteParameterServer(
+                    [f"127.0.0.1:{p}" for p in ports], init_params,
+                    timeout=history_timeout + 60.0, token=token,
+                    codec=codec_name)
+                local_ps = client
         else:
             addr, token = rps.share_service_address(None)
+            addresses = addr.split(",")
             # socket timeout must outlive the history barrier, or a slow
             # pod turns the server's informative barrier-timeout error
             # into a bare client-side socket.timeout
-            client = rps.RemoteParameterServer(
-                addr, init_params, timeout=history_timeout + 60.0,
-                token=token,
-                codec="raw" if runner.codec is None else runner.codec.name)
+            if len(addresses) == 1:
+                client = rps.RemoteParameterServer(
+                    addresses[0], init_params,
+                    timeout=history_timeout + 60.0, token=token,
+                    codec=codec_name)
+            else:
+                client = elastic_mod.ShardedRemoteParameterServer(
+                    addresses, init_params, timeout=history_timeout + 60.0,
+                    token=token, codec=codec_name)
             local_ps = client
             # the authoritative start state lives at the center (matters on
             # resume: process 0 restored it; also seeds EASGD replicas)
@@ -573,7 +727,7 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
                    start_clock=start_clock, ps=local_ps,
                    worker_offset=worker_offset, fetch_final=False,
                    watchdog=watchdog)
-        if pid == 0:
+        if pid == 0 and client is None:
             service.put_history(0, runner.merged_windows)
             merged, center, clock = service.get_history_blocking(
                 timeout=history_timeout)
@@ -589,6 +743,8 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
             client.close()
         if service is not None:
             service.stop()
+        for svc in services:
+            svc.stop()
     history = [step for _, _, steps in merged for step in steps]
     stal = [float(s) for _, s, _ in merged]
     return device_get_batched(center), history, stal, int(clock)

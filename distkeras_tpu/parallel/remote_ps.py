@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import collections
 import json
+import os
 import socket
 import struct
 import threading
@@ -46,7 +47,10 @@ import numpy as np
 
 from distkeras_tpu import comms, telemetry
 from distkeras_tpu.health.endpoints import HEALTH_OPS, handle_health_op
-from distkeras_tpu.parameter_servers import ParameterServer
+from distkeras_tpu.health.membership import Membership
+from distkeras_tpu.parameter_servers import ParameterServer, \
+    dynsgd_fold_weight
+from distkeras_tpu.utils import fault
 from distkeras_tpu.utils.fetch import device_get_batched
 
 
@@ -93,6 +97,25 @@ def recv_message(sock: socket.socket) -> Tuple[dict, list]:
 
 _sendall = send_message  # internal aliases, kept for brevity below
 _recv = recv_message
+
+
+class PSUnavailable(RuntimeError):
+    """The parameter service could not be reached within the retry budget.
+
+    Raised by :class:`RemoteParameterServer` after reconnect/backoff
+    exhaustion — the typed signal HostAsyncRunner's degradation ladder
+    keys on (compute-only windows against the stale center, fold the
+    accumulated delta on reconnect) instead of crashing the worker on a
+    bare socket error."""
+
+
+class HistoryBarrierTimeout(RuntimeError, TimeoutError):
+    """The end-of-run history barrier expired before every process (or
+    shard) reported — typed so callers can distinguish "the fleet never
+    converged on a final center" from a transport timeout, instead of
+    silently proceeding with partial history. Also a RuntimeError: that
+    is what this condition surfaced as before it was typed, and callers'
+    broad handlers keep working."""
 
 
 def check_token(expected: Optional[str], header: dict) -> bool:
@@ -181,11 +204,19 @@ class ParameterServerService:
     window histories from every process (``history_put``/``history_get``).
     """
 
+    #: bounded per-client replay window: how many (seq → reply) entries
+    #: the commit dedup cache keeps per cid. A client retries at most one
+    #: in-flight commit per worker thread, so 128 is orders of magnitude
+    #: of slack — the bound exists so a long run cannot grow the cache.
+    DEDUP_CACHE = 128
+
     def __init__(self, ps: ParameterServer, like,
                  expected_processes: int = 1,
                  host: str = "0.0.0.0", port: int = 0,
                  token: Optional[str] = None,
-                 codecs: Optional[Sequence[str]] = None):
+                 codecs: Optional[Sequence[str]] = None,
+                 membership: Optional[Membership] = None,
+                 shard: int = 0, num_shards: int = 1):
         self.ps = ps
         self.codec = _TreeCodec(like)
         # wire codecs this server will grant in the hello handshake
@@ -194,6 +225,17 @@ class ParameterServerService:
             else comms.available_codecs()
         self.expected = int(expected_processes)
         self.token = token  # ADVICE r5: required in every request header
+        # elastic fleet (DESIGN.md §13): the membership table lives on the
+        # coordinator shard (shard 0) only; follower shards fold with the
+        # coordinator's explicit weight and keep no member state
+        self.membership = membership
+        self.shard = int(shard)
+        self.num_shards = int(num_shards)
+        #: full fleet map ("host:port" per shard), set by the launcher once
+        #: every shard is up; served to late joiners via the shard_map op
+        self.shard_addresses: Optional[list] = None
+        self._dedup: dict = {}  # cid -> OrderedDict(seq -> commit reply)
+        self._dedup_lock = threading.Lock()
         self._histories: dict[int, list] = {}
         self._hist_cv = threading.Condition()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -231,6 +273,16 @@ class ParameterServerService:
     def stop(self) -> None:
         self._running = False
         try:
+            # shutdown() wakes an accept() blocked in the loop thread; a
+            # bare close() would leave that in-flight syscall holding the
+            # open file description, and the kernel would hand it exactly
+            # one more connection — which a reconnecting fault-tolerant
+            # client is quick enough to be (established connections are
+            # deliberately left serving; only the listener dies here)
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass
@@ -260,7 +312,13 @@ class ParameterServerService:
                                           codec=granted).inc()
                         _sendall(conn, {"codec": granted})
                         continue
-                    self._dispatch(conn, header, blobs, codec)
+                    try:
+                        self._dispatch(conn, header, blobs, codec)
+                    except ConnectionError:
+                        # chaos-injected server reset, or the peer vanished
+                        # mid-reply: this connection is done, the service
+                        # lives on (the client reconnects and retries)
+                        return
         except Exception:
             if self._running:  # surface handler crashes, don't die silently
                 raise
@@ -270,6 +328,13 @@ class ParameterServerService:
     def _dispatch(self, conn, header: dict, blobs: list,
                   codec: Optional[_TreeCodec] = None):
         op = header["op"]
+        act = fault.chaos("remote_ps.server.handle")
+        if act is not None:
+            if act.action == "delay":
+                time.sleep(act.delay_s)  # a stalled shard, from outside
+            else:  # either reset flavor: kill the connection, no reply
+                conn.close()
+                raise ConnectionError("chaos: server reset the connection")
         telemetry.counter("remote_ps.server.dispatch", op=op).inc()
         telemetry.counter("remote_ps.server.bytes_received").inc(
             sum(len(b) for b in blobs))
@@ -296,12 +361,60 @@ class ParameterServerService:
             self._reply(conn, op, {"clock": clock},
                         codec.encode(center, kind="pull"))
         elif op == "commit":
+            # idempotency check BEFORE decode: a retried commit (client
+            # sent, reply lost, client reconnected and re-sent) must fold
+            # exactly once, and the replay should not even pay the decode
+            cid, seq = header.get("cid"), header.get("seq")
+            if cid is not None and seq is not None:
+                cached = self._dedup_get(cid, seq)
+                if cached is not None:
+                    telemetry.counter("remote_ps.server.dedup_hits").inc()
+                    self._reply(conn, op, cached)
+                    return
             # decode ONCE into the leaves' native dtypes; the PS folds the
             # decoded tree directly (no second materialization)
             delta = codec.decode(blobs, kind="commit")
-            at_fold = self.ps.commit(delta,
-                                     last_update=header["last_update"])
-            self._reply(conn, op, {"at_fold": at_fold})
+            worker = header.get("worker")
+            weight = header.get("weight")  # follower-shard explicit fold
+            if (weight is None and worker is not None
+                    and self.membership is not None
+                    and self.membership.should_late_fold(worker)):
+                # an evicted worker returned: DynSGD-weight its stale
+                # commit regardless of server flavor (DESIGN.md §13)
+                weight = dynsgd_fold_weight
+                telemetry.counter("elastic.late_folds").inc()
+            at_fold, applied = self.ps.commit_ex(
+                delta, last_update=header["last_update"], weight=weight)
+            if worker is not None and self.membership is not None:
+                # a landed commit is proof of life: renew the lease,
+                # re-admit if evicted, feed the straggler detector
+                self.membership.observe_commit(worker,
+                                               header.get("window_s"))
+            reply = {"at_fold": at_fold, "weight": applied}
+            if cid is not None and seq is not None:
+                self._dedup_put(cid, seq, reply)
+            self._reply(conn, op, reply)
+        elif op == "register":
+            if self.membership is None:
+                # not the coordinator shard (or membership disabled):
+                # lease 0 tells the worker there is no lease to keep
+                self._reply(conn, op, {"lease_s": 0.0, "elastic": False})
+            else:
+                lease = self.membership.register(header["worker"],
+                                                 header.get("lease_s"))
+                self._reply(conn, op, {"lease_s": lease, "elastic": True})
+        elif op == "lease_renew":
+            evicted = (self.membership.renew(header["worker"])
+                       if self.membership is not None else False)
+            self._reply(conn, op, {"evicted": evicted})
+        elif op == "deregister":
+            if self.membership is not None:
+                self.membership.deregister(header["worker"])
+            self._reply(conn, op, {"ok": True})
+        elif op == "shard_map":
+            self._reply(conn, op, {
+                "shard": self.shard, "num_shards": self.num_shards,
+                "addresses": list(self.shard_addresses or [])})
         elif op == "clock":
             self._reply(conn, op, {"clock": self.ps.pull()[1]})
         elif op == "history_put":
@@ -326,7 +439,8 @@ class ParameterServerService:
             if len(uploaded) < self.expected:
                 _sendall(conn, {"error": "history barrier timeout: "
                                 f"{uploaded} of "
-                                f"{self.expected} processes uploaded"})
+                                f"{self.expected} processes uploaded",
+                                "error_kind": "history-timeout"})
                 return
             center, clock = self.ps.pull()
             self._reply(conn, op, {"windows": merged, "clock": clock},
@@ -343,9 +457,26 @@ class ParameterServerService:
                 "histories_uploaded": uploaded,
                 "uptime_s": round(time.time() - self._t_start, 3),
                 "port": self.port,
+                "shard": self.shard,
+                "num_shards": self.num_shards,
+                **({"membership": self.membership.status()}
+                   if self.membership is not None else {}),
             }))
         else:
             _sendall(conn, {"error": f"unknown op {op!r}"})
+
+    # -- commit idempotency (retried commits fold once) --------------------
+    def _dedup_get(self, cid: str, seq) -> Optional[dict]:
+        with self._dedup_lock:
+            replies = self._dedup.get(cid)
+            return None if replies is None else replies.get(int(seq))
+
+    def _dedup_put(self, cid: str, seq, reply: dict) -> None:
+        with self._dedup_lock:
+            replies = self._dedup.setdefault(cid, collections.OrderedDict())
+            replies[int(seq)] = reply
+            while len(replies) > self.DEDUP_CACHE:
+                replies.popitem(last=False)
 
     # -- direct (in-process) counterparts for process 0 -------------------
     def put_history(self, pid: int, windows: list) -> None:
@@ -360,7 +491,7 @@ class ParameterServerService:
                 lambda: len(self._histories) >= self.expected,
                 timeout=timeout)
             if not ok:
-                raise TimeoutError(
+                raise HistoryBarrierTimeout(
                     f"history barrier: {sorted(self._histories)} of "
                     f"{self.expected} processes uploaded")
             merged = sorted(
@@ -389,53 +520,206 @@ class RemoteParameterServer:
     answers with what it granted (``.negotiated``; falls back to "raw"
     when the server lacks the codec). Lossy codecs apply error feedback
     to commits inside the tree codec (comms/codec.py).
+
+    Transport faults are survived, not surfaced (DESIGN.md §13): a failed
+    round-trip tears the connection down (failing every pipelined waiter,
+    who each retry), reconnects with exponential backoff + seeded jitter
+    (``retry=``), re-plays the hello handshake, and re-sends. Commits
+    carry a client-generated ``(cid, seq)`` identity the server dedups
+    on, so "applied but the reply was lost" folds exactly once. When the
+    budget is exhausted the caller gets a typed :class:`PSUnavailable` —
+    the signal HostAsyncRunner's degradation ladder keys on.
     """
 
+    #: elastic-aware transport: host_async stamps worker identity and
+    #: window duration into commits when this is True (the in-process
+    #: ParameterServer classes are not on the membership plane).
+    elastic = True
+
     def __init__(self, address: str, like, timeout: float = 600.0,
-                 token: Optional[str] = None, codec: str = "raw"):
+                 token: Optional[str] = None, codec: str = "raw",
+                 retry: Optional[comms.RetryPolicy] = None,
+                 op_timeout: Optional[float] = None):
         host, port = address.rsplit(":", 1)
         self._addr = (host, int(port))
         self._timeout = timeout
+        # per-op reply deadline: a vanished peer becomes a retry after
+        # this long, instead of a hang for the full connect timeout
+        self._op_timeout = float(op_timeout) if op_timeout else \
+            float(timeout)
         self.codec = _TreeCodec(like)
         self.token = token
-        self._sock = socket.create_connection(self._addr, timeout=timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.retry = retry if retry is not None else comms.DEFAULT_RETRY
+        self._requested = comms.get_codec(codec).name
+        self.negotiated = "raw"
         self._send_lock = threading.Lock()
         self._recv_cv = threading.Condition()
         self._pending: collections.deque = collections.deque()
+        self._sock: Optional[socket.socket] = None
+        self._gen = 0  # bumped on every teardown: stale waiters see it
+        self._ever_connected = False
         self._ctrl_sock: Optional[socket.socket] = None
         self._ctrl_lock = threading.Lock()
-        self.negotiated = "raw"
-        if comms.get_codec(codec).name != "raw":
-            resp, _ = self._roundtrip({"op": "hello",
-                                       "codec": comms.get_codec(codec).name})
-            self.negotiated = resp["codec"]
-            self.codec.set_wire(self.negotiated)
+        self._closed = False
+        # commit identity: one cid per client process, a fresh seq per
+        # LOGICAL commit — every retry (and every shard, via the sharded
+        # client) re-uses the same (cid, seq); that identity is what the
+        # server's dedup cache folds once
+        self.cid = os.urandom(8).hex()
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        with self._send_lock:
+            self._ensure_connected()  # fail fast on a bad address
 
-    def _roundtrip(self, header: dict, blobs=()) -> Tuple[dict, list]:
+    def next_seq(self) -> int:
+        """Allocate the next logical-commit sequence number (shared by
+        every shard of one commit in the sharded client)."""
+        with self._seq_lock:
+            self._seq += 1
+            return self._seq
+
+    # -- connection lifecycle ---------------------------------------------
+    def _ensure_connected(self) -> None:
+        """(Re)open the data connection; caller holds ``_send_lock``."""
+        if self._closed:
+            raise PSUnavailable(
+                f"client for {self._addr[0]}:{self._addr[1]} is closed")
+        if self._sock is not None:
+            return
+        sock = socket.create_connection(self._addr, timeout=self._timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            if self._requested != "raw":
+                # re-play the codec handshake on every (re)connect: the
+                # server starts each fresh connection on the raw codec
+                hello = {"op": "hello", "codec": self._requested}
+                if self.token is not None:
+                    hello["token"] = self.token
+                # dktlint: disable=lock-blocking-call
+                _sendall(sock, hello)
+                resp, _ = _recv(sock)  # dktlint: disable=lock-blocking-call
+                if "error" in resp:
+                    raise ConnectionError(
+                        f"hello refused: {resp['error']}")
+                granted = resp.get("codec", "raw")
+                if granted != self.negotiated:
+                    # set_wire resets error-feedback state — only on an
+                    # actual codec change, never on a plain reconnect
+                    self.negotiated = granted
+                    self.codec.set_wire(granted)
+        except BaseException:
+            sock.close()
+            raise
+        self._sock = sock
+        if self._ever_connected:
+            telemetry.counter("remote_ps.client.reconnects").inc()
+        self._ever_connected = True
+
+    def _teardown_locked(self) -> None:
+        """Close the data connection and fail every pipelined waiter;
+        caller holds ``_send_lock``. The generation bump is how waiters
+        blocked in ``_roundtrip_once`` learn their reply will never come
+        (their retry loop reconnects and re-sends)."""
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        with self._recv_cv:
+            self._gen += 1
+            self._pending.clear()
+            self._recv_cv.notify_all()
+
+    def _teardown(self, gen: int) -> None:
+        with self._send_lock:
+            if self._gen == gen:  # lost the race: someone already did
+                self._teardown_locked()
+
+    # -- round-trips ------------------------------------------------------
+    def _roundtrip_once(self, header: dict, blobs,
+                        timeout: float) -> Tuple[dict, list]:
+        ticket = object()
+        with self._send_lock:
+            self._ensure_connected()
+            sock, gen = self._sock, self._gen
+            act = fault.chaos("remote_ps.send")
+            if act is not None and act.action == "delay":
+                time.sleep(act.delay_s)  # dktlint: disable=lock-blocking-call
+            if act is not None and act.action == "reset":
+                self._teardown_locked()
+                raise ConnectionError("chaos: connection reset before send")
+            dropped = act is not None and act.action == "drop"
+            if not dropped:
+                # enqueue BEFORE releasing the send lock: wire order and
+                # waiter order must agree or responses would cross-match.
+                # Sending under the lock is the point: it serializes
+                # frames on the shared socket (pipelining is recv-side).
+                # dktlint: disable=lock-blocking-call
+                _sendall(sock, header, blobs)
+                if act is not None and act.action == "reset_after_send":
+                    # the request DID reach the wire: the server applies
+                    # it and replies into a closed socket — the dedup
+                    # scenario
+                    self._teardown_locked()
+                    raise ConnectionError(
+                        "chaos: connection reset after send")
+                with self._recv_cv:
+                    self._pending.append(ticket)
+        if dropped:
+            # a swallowed request never gets a ticket: FIFO reply matching
+            # cannot survive selective loss on a live stream, so the drop
+            # rides out the op timeout and then declares the connection
+            # dead (which is what a real lost frame amounts to here)
+            time.sleep(min(timeout, 5.0) if timeout else 1.0)
+            self._teardown(gen)
+            raise socket.timeout("chaos: request dropped")
+        with self._recv_cv:
+            while not (self._pending and self._pending[0] is ticket):
+                if self._gen != gen or ticket not in self._pending:
+                    raise ConnectionError(
+                        "connection torn down while awaiting reply")
+                self._recv_cv.wait(timeout=1.0)
+        # head of the pipeline: this thread owns the next reply
+        try:
+            sock.settimeout(timeout)
+            resp, rblobs = _recv(sock)
+        except (ConnectionError, socket.timeout, OSError):
+            self._teardown(gen)
+            raise
+        with self._recv_cv:
+            if self._gen == gen:
+                self._pending.popleft()
+                self._recv_cv.notify_all()
+        return resp, rblobs
+
+    def _roundtrip(self, header: dict, blobs=(),
+                   timeout: Optional[float] = None) -> Tuple[dict, list]:
         op = header.get("op", "?")
         if self.token is not None:
             header = dict(header, token=self.token)
+        timeout = self._op_timeout if timeout is None else timeout
         t0 = time.perf_counter()
-        ticket = object()
-        with self._send_lock:
-            # enqueue BEFORE releasing the send lock: wire order and
-            # waiter order must agree or responses would cross-match.
-            # Sending under the lock is the point: it serializes frames on
-            # the shared socket (pipelining happens at the recv side).
-            # dktlint: disable=lock-blocking-call
-            _sendall(self._sock, header, blobs)
-            with self._recv_cv:
-                self._pending.append(ticket)
-        with self._recv_cv:
-            while self._pending[0] is not ticket:
-                self._recv_cv.wait()
-        try:
-            resp, rblobs = _recv(self._sock)
-        finally:
-            with self._recv_cv:
-                self._pending.popleft()
-                self._recv_cv.notify_all()
+        attempt = 0
+        while True:
+            try:
+                resp, rblobs = self._roundtrip_once(header, blobs, timeout)
+                break
+            except (ConnectionError, socket.timeout, OSError) as e:
+                if self._closed:
+                    raise PSUnavailable(
+                        f"client for {self._addr[0]}:{self._addr[1]} is "
+                        f"closed") from e
+                attempt += 1
+                if attempt > self.retry.max_retries:
+                    telemetry.counter("remote_ps.client.unavailable",
+                                      op=op).inc()
+                    raise PSUnavailable(
+                        f"parameter service {self._addr[0]}:"
+                        f"{self._addr[1]} unavailable: {op} failed after "
+                        f"{self.retry.max_retries} retries ({e})") from e
+                telemetry.counter("remote_ps.client.retries", op=op).inc()
+                time.sleep(self.retry.delay(attempt))
         # rtt includes the wait for the shared connection: the contention
         # profile of the one-socket-per-process design is part of what a
         # STALENESS round wants to see
@@ -450,60 +734,157 @@ class RemoteParameterServer:
         telemetry.counter("comms.bytes_recv", op=op, side="client").inc(
             sum(len(b) for b in rblobs))
         if "error" in resp:
+            if resp.get("error_kind") == "history-timeout":
+                raise HistoryBarrierTimeout(resp["error"])
             raise RuntimeError(f"parameter service: {resp['error']}")
         return resp, rblobs
 
-    def _control_roundtrip(self, header: dict) -> dict:
-        """Small blob-free ops on a dedicated connection (opened on first
-        use): a clock poll answers in one small-packet RTT even while the
-        data connection is mid-way through a large commit."""
-        if self.token is not None:
-            header = dict(header, token=self.token)
+    def _control_once(self, header: dict, timeout: float) -> dict:
         # the control channel is intentionally one-request-at-a-time: the
         # lock held over connect/send/recv IS the serialization (only
-        # small header-only frames travel here, bounded by self._timeout)
+        # small header-only frames travel here, bounded by the timeout)
         with self._ctrl_lock:
+            if self._closed:
+                raise PSUnavailable(
+                    f"client for {self._addr[0]}:{self._addr[1]} is closed")
             if self._ctrl_sock is None:
                 # dktlint: disable=lock-blocking-call
                 self._ctrl_sock = socket.create_connection(
                     self._addr, timeout=self._timeout)
                 self._ctrl_sock.setsockopt(socket.IPPROTO_TCP,
                                            socket.TCP_NODELAY, 1)
-            _sendall(self._ctrl_sock, header)  # dktlint: disable=lock-blocking-call
-            resp, _ = _recv(self._ctrl_sock)  # dktlint: disable=lock-blocking-call
+            try:
+                self._ctrl_sock.settimeout(timeout)
+                _sendall(self._ctrl_sock, header)  # dktlint: disable=lock-blocking-call
+                resp, _ = _recv(self._ctrl_sock)  # dktlint: disable=lock-blocking-call
+            except (ConnectionError, socket.timeout, OSError):
+                try:
+                    self._ctrl_sock.close()
+                except OSError:
+                    pass
+                self._ctrl_sock = None
+                raise
         if "error" in resp:
             raise RuntimeError(f"parameter service: {resp['error']}")
         return resp
 
+    def _control_roundtrip(self, header: dict,
+                           timeout: Optional[float] = None) -> dict:
+        """Small blob-free ops on a dedicated connection (opened on first
+        use): a clock poll answers in one small-packet RTT even while the
+        data connection is mid-way through a large commit. Same bounded
+        reconnect/backoff as the data path."""
+        op = header.get("op", "?")
+        if self.token is not None:
+            header = dict(header, token=self.token)
+        timeout = self._op_timeout if timeout is None else timeout
+        attempt = 0
+        while True:
+            try:
+                return self._control_once(header, timeout)
+            except PSUnavailable:
+                raise
+            except (ConnectionError, socket.timeout, OSError) as e:
+                attempt += 1
+                if attempt > self.retry.max_retries:
+                    telemetry.counter("remote_ps.client.unavailable",
+                                      op=op).inc()
+                    raise PSUnavailable(
+                        f"parameter service {self._addr[0]}:"
+                        f"{self._addr[1]} unavailable: {op} failed after "
+                        f"{self.retry.max_retries} retries ({e})") from e
+                telemetry.counter("remote_ps.client.retries", op=op).inc()
+                time.sleep(self.retry.delay(attempt))
+
+    # -- ParameterServer interface ----------------------------------------
     def pull(self):
         resp, blobs = self._roundtrip({"op": "pull"})
         return self.codec.decode(blobs, kind="pull"), resp["clock"]
 
-    def commit(self, delta: Any, last_update: int = 0) -> int:
-        resp, _ = self._roundtrip(
-            {"op": "commit", "last_update": int(last_update)},
-            self.codec.encode(delta, kind="commit"))
-        return resp["at_fold"]
+    def commit(self, delta: Any, last_update: int = 0, **kw) -> int:
+        return self.commit_ex(delta, last_update=last_update, **kw)[0]
+
+    def commit_ex(self, delta: Any, last_update: int = 0, weight=None,
+                  seq: Optional[int] = None, worker: Optional[int] = None,
+                  window_s: Optional[float] = None) -> tuple:
+        """Commit with the applied fold weight surfaced; returns
+        ``(at_fold, weight)``. The delta is encoded ONCE, before the
+        retry loop — a lossy codec's error-feedback state must not be
+        double-charged by a re-send of the same logical commit."""
+        header = {"op": "commit", "last_update": int(last_update),
+                  "cid": self.cid,
+                  "seq": int(seq) if seq is not None else self.next_seq()}
+        if weight is not None:
+            header["weight"] = float(weight)
+        if worker is not None:
+            header["worker"] = int(worker)
+        if window_s is not None:
+            header["window_s"] = float(window_s)
+        resp, _ = self._roundtrip(header,
+                                  self.codec.encode(delta, kind="commit"))
+        return resp["at_fold"], resp.get("weight", 1.0)
 
     @property
     def num_updates(self) -> int:
         return self._control_roundtrip({"op": "clock"})["clock"]
 
+    # -- elastic membership (coordinator shard only; DESIGN.md §13) -------
+    def register(self, worker: int,
+                 lease_s: Optional[float] = None) -> float:
+        """Join the fleet; returns the granted lease in seconds (0.0 when
+        the peer runs no membership plane)."""
+        header = {"op": "register", "worker": int(worker)}
+        if lease_s is not None:
+            header["lease_s"] = float(lease_s)
+        return float(self._control_roundtrip(header)["lease_s"])
+
+    def renew_lease(self, worker: int) -> bool:
+        """Heartbeat the lease; True means the coordinator has this
+        worker marked evicted (its next commit will late-fold)."""
+        return bool(self._control_roundtrip(
+            {"op": "lease_renew", "worker": int(worker)})["evicted"])
+
+    def deregister(self, worker: int) -> None:
+        self._control_roundtrip({"op": "deregister", "worker": int(worker)})
+
+    def shard_map(self) -> dict:
+        """The fleet layout as the peer knows it:
+        ``{shard, num_shards, addresses}`` (late-joiner bootstrap)."""
+        return self._control_roundtrip({"op": "shard_map"})
+
+    # -- end-of-run history barrier ---------------------------------------
     def put_history(self, pid: int, windows: list) -> None:
         self._roundtrip({"op": "history_put", "pid": int(pid),
                          "windows": [[int(c), float(s), steps]
                                      for c, s, steps in windows]})
 
     def get_history(self, timeout: float = 600):
+        # reply deadline = the server-side barrier timeout plus transport
+        # slack; a barrier failure arrives as a typed HistoryBarrierTimeout
         resp, blobs = self._roundtrip({"op": "history_get",
-                                       "timeout": timeout})
+                                       "timeout": timeout},
+                                      timeout=timeout + 30.0)
         return (resp["windows"], self.codec.decode(blobs, kind="pull"),
                 resp["clock"])
 
     def close(self) -> None:
-        for sock in (self._sock, self._ctrl_sock):
-            if sock is None:
-                continue
+        """Idempotent teardown (runner exit AND test teardown may both
+        call it). The control connection is closed even if a control
+        round-trip is in flight: the lock acquire is bounded, and closing
+        the socket out from under the op fails it fast instead of holding
+        close() hostage for the op's full timeout."""
+        if self._closed:
+            return
+        self._closed = True
+        with self._send_lock:
+            self._teardown_locked()
+        got = self._ctrl_lock.acquire(timeout=1.0)
+        try:
+            sock, self._ctrl_sock = self._ctrl_sock, None
+        finally:
+            if got:
+                self._ctrl_lock.release()
+        if sock is not None:
             try:
                 sock.close()
             except OSError:
@@ -517,12 +898,18 @@ class RemoteParameterServer:
         pass
 
 
-def share_service_address(port: Optional[int],
+def share_service_address(ports,
                           token: Optional[str] = None,
                           error: bool = False) -> Tuple[str, Optional[str]]:
     """Agree on the service address AND auth token across processes:
     process 0 broadcasts ``host:port|token`` through a tiny collective;
     everyone returns the same ``(address, token)`` pair.
+
+    ``ports`` may be a single port or a sequence of them (a shard fleet,
+    DESIGN.md §13): the broadcast payload is then the full shard map,
+    ``host:p0,host:p1,...|token`` in shard order — a single shard
+    produces byte-for-byte the single-server payload, so N=1 stays
+    wire-compatible. Callers split the returned address on ``","``.
 
     ``error=True`` (process 0 only) broadcasts a failure sentinel instead —
     the symmetric-agreement half of service construction (ADVICE r5): if
@@ -534,12 +921,16 @@ def share_service_address(port: Optional[int],
 
     from distkeras_tpu.parallel.distributed import determine_host_address
 
+    port_list = list(ports) if isinstance(ports, (list, tuple)) \
+        else [ports]
     if jax.process_count() == 1:
-        return f"127.0.0.1:{port}", token
-    payload = np.zeros((192,), np.uint8)
+        return ",".join(f"127.0.0.1:{p}" for p in port_list), token
+    payload = np.zeros((512,), np.uint8)  # sized for a multi-shard map
     if jax.process_index() == 0:
+        host = determine_host_address()
         msg = ("!service construction failed on process 0" if error
-               else f"{determine_host_address()}:{port}|{token or ''}")
+               else ",".join(f"{host}:{p}" for p in port_list)
+               + f"|{token or ''}")
         raw = msg.encode()
         if len(raw) > payload.size:
             raise ValueError(f"payload {raw!r} longer than "

@@ -99,7 +99,13 @@ class ADAG(Strategy):
 
 
 class DynSGD(Strategy):
-    """DynSGD: DOWNPOUR deltas, server scales each by 1/(staleness+1)."""
+    """DynSGD: DOWNPOUR deltas, server scales each by 1/(staleness+1).
+
+    Host-side folds (``parameter_servers.dynsgd_fold_weight``, and the
+    elastic late-fold path in ``parallel/remote_ps.py``) must stay in
+    lockstep with this device-side rule — it is the same curve traced in
+    float32 instead of python floats.
+    """
 
     name = "dynsgd"
 

@@ -62,11 +62,47 @@ class ParameterServer:
         telemetry.histogram("ps.commit.staleness").record(staleness)
         telemetry.histogram("ps.commit.handle_s").record(dur_s)
 
+    def fold_weight(self, staleness: int) -> float:
+        """The server rule's scale for a commit folded at the given
+        staleness (server clock at fold minus clock at the committer's
+        pull). Base/Delta: 1.0 regardless; DynSGD overrides."""
+        return 1.0
+
     def commit(self, delta: Any, last_update: int = 0) -> int:
         """Fold a delta into the center. Returns the server clock at fold
         time (BEFORE this commit increments it) — the committer's true
         staleness is that value minus the clock at its pull."""
-        raise NotImplementedError
+        return self.commit_ex(delta, last_update=last_update)[0]
+
+    def commit_ex(self, delta: Any, last_update: int = 0,
+                  weight=None) -> tuple:
+        """:meth:`commit` with the fold weight surfaced and overridable —
+        the sharded-PS primitive (DESIGN.md §13). Returns
+        ``(at_fold, applied_weight)``.
+
+        ``weight=None`` applies the class rule (:meth:`fold_weight`);
+        a float applies that exact scale (a follower shard folding with
+        the coordinator's authoritative weight, so one logical commit is
+        scaled identically on every shard); a callable is evaluated as
+        ``weight(staleness)`` at fold time under the lock (the elastic
+        late-fold path: an evicted worker's commit is DynSGD-weighted on
+        ANY server flavor, so convergence survives churn)."""
+        delta = self._to_center_device(delta)
+        t0 = time.perf_counter()
+        with self._lock:
+            at_fold = self.num_updates
+            staleness = at_fold - int(last_update)
+            if weight is None:
+                w = self.fold_weight(staleness)
+            elif callable(weight):
+                w = float(weight(staleness))
+            else:
+                w = float(weight)
+            self.center_variable = _fold(self.center_variable, delta,
+                                         jnp.float32(w))
+            self.num_updates += 1
+        self._note_commit(staleness, time.perf_counter() - t0)
+        return at_fold, w
 
     def _to_center_device(self, tree: Any) -> Any:
         """Bring a worker's delta to the center's device — the explicit
@@ -98,19 +134,8 @@ def _fold(center, delta, weight):
 
 class DeltaParameterServer(ParameterServer):
     """center += delta (DOWNPOUR/ADAG/(A)EASGD server rule; ADAG's window
-    normalization happens worker-side, see NUMERICS.md)."""
-
-    def commit(self, delta: Any, last_update: int = 0) -> int:
-        delta = self._to_center_device(delta)
-        t0 = time.perf_counter()
-        with self._lock:
-            at_fold = self.num_updates
-            self.center_variable = _fold(self.center_variable, delta,
-                                         jnp.float32(1.0))
-            self.num_updates += 1
-        self._note_commit(at_fold - int(last_update),
-                          time.perf_counter() - t0)
-        return at_fold
+    normalization happens worker-side, see NUMERICS.md). The fold weight
+    is the base class's constant 1.0."""
 
 
 # The reference gives ADAG its own server class; the fold is identical to
@@ -118,22 +143,22 @@ class DeltaParameterServer(ParameterServer):
 ADAGParameterServer = DeltaParameterServer
 
 
+def dynsgd_fold_weight(staleness: int) -> float:
+    """The DynSGD server rule, 1/(staleness+1), as a host-side float —
+    shared by :class:`DynSGDParameterServer` and the elastic late-fold
+    path (an evicted worker's returning commit is folded with exactly
+    this scale on any server flavor; the jnp twin for in-graph folds is
+    ``strategies.DynSGD.staleness_weight``)."""
+    if staleness < 0:
+        raise ValueError(
+            f"staleness must be >= 0, got {staleness} (committer's "
+            f"last_update is ahead of the server clock)")
+    return 1.0 / (float(staleness) + 1.0)
+
+
 class DynSGDParameterServer(ParameterServer):
     """center += delta / (staleness + 1), staleness = server clock at commit
     minus server clock at the committer's last pull."""
 
-    def commit(self, delta: Any, last_update: int = 0) -> int:
-        delta = self._to_center_device(delta)
-        t0 = time.perf_counter()
-        with self._lock:
-            at_fold = self.num_updates
-            staleness = at_fold - int(last_update)
-            if staleness < 0:
-                raise ValueError(
-                    f"last_update {last_update} is ahead of the server clock "
-                    f"{self.num_updates}")
-            self.center_variable = _fold(self.center_variable, delta,
-                                         jnp.float32(1.0 / (staleness + 1)))
-            self.num_updates += 1
-        self._note_commit(staleness, time.perf_counter() - t0)
-        return at_fold
+    def fold_weight(self, staleness: int) -> float:
+        return dynsgd_fold_weight(staleness)

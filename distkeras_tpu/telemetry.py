@@ -82,7 +82,13 @@ METRIC_NAMES = {
     "data.prefetch.puts": "counter",
     "data.prefetch.queue_depth": "gauge",
     "data.prefetch.queue_depth_samples": "histogram",
+    # elastic fleet membership (health/membership.py + remote_ps commits)
+    "elastic.evictions": "counter",
+    "elastic.late_folds": "counter",
+    "elastic.readmissions": "counter",
+    "elastic.workers": "gauge",
     # fault injection
+    "fault.chaos": "counter",
     "fault.injected": "counter",
     # health plane
     "health.straggler.events": "counter",
@@ -101,6 +107,7 @@ METRIC_NAMES = {
     # host-driven async trainer
     "host_async.commit_clock_lag": "histogram",
     "host_async.commit_s": "histogram",
+    "host_async.degraded_windows": "counter",
     "host_async.pull_s": "histogram",
     "host_async.save.count": "counter",
     "host_async.save_s": "histogram",
@@ -121,8 +128,12 @@ METRIC_NAMES = {
     # remote (socket) parameter server
     "remote_ps.client.bytes_received": "counter",
     "remote_ps.client.bytes_sent": "counter",
+    "remote_ps.client.reconnects": "counter",
+    "remote_ps.client.retries": "counter",
     "remote_ps.client.rtt_s": "histogram",
+    "remote_ps.client.unavailable": "counter",
     "remote_ps.server.auth_failures": "counter",
+    "remote_ps.server.dedup_hits": "counter",
     "remote_ps.server.bytes_received": "counter",
     "remote_ps.server.dispatch": "counter",
     "remote_ps.server.handle_s": "histogram",

@@ -314,6 +314,7 @@ class DistributedTrainer(Trainer):
                  accum_steps: int = 1,
                  precision: Optional[str] = None,
                  bucket_bytes: Optional[int] = None,
+                 ps_shards: int = 1,
                  **strategy_kwargs):
         super().__init__(model, loss, worker_optimizer, learning_rate,
                          metrics, features_col, label_col, batch_size,
@@ -410,6 +411,17 @@ class DistributedTrainer(Trainer):
                 "exchange; sync mode folds commits in-graph (no wire)")
         self.codec = codec
         self.comms_overlap = bool(comms_overlap)
+        # sharded parameter-server fleet (DESIGN.md §13): in cross-process
+        # host_async, split the center over this many shard services on
+        # process 0 (shard 0 carries the membership/lease plane). 1 = the
+        # single-service protocol, wire-compatible with prior releases.
+        self.ps_shards = int(ps_shards)
+        if self.ps_shards < 1:
+            raise ValueError(f"ps_shards must be >= 1, got {ps_shards}")
+        if self.ps_shards > 1 and mode != "host_async":
+            raise ValueError(
+                "ps_shards shards the host_async parameter service; sync "
+                "mode has no parameter server to shard")
         # health monitoring (DESIGN.md §9): None | policy string | dict |
         # HealthConfig — normalized here so a bad policy fails at
         # construction. A fresh TrainingWatchdog is built per train() call
@@ -922,7 +934,7 @@ class DistributedTrainer(Trainer):
                             runner, init_params, epoch_shards,
                             worker_offset=worker_offset, checkpointer=ckpt,
                             checkpoint_folds=folds, start_clock=start_clock,
-                            watchdog=watchdog)
+                            watchdog=watchdog, ps_shards=self.ps_shards)
                 else:
                     params, history, staleness, num_updates = runner.run(
                         init_params, epoch_shards, checkpointer=ckpt,

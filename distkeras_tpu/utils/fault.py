@@ -13,6 +13,17 @@ values through :func:`apply`, and a test (or chaos run) arms a corruption
 with :func:`inject` — e.g. ``inject("host_async.window_loss", after=3)``
 makes the fourth observed window loss a NaN, which the training watchdog
 must catch. Hooks are empty-dict cheap when nothing is armed.
+
+Beyond value corruption, the elastic-fleet work (DESIGN.md §13) adds
+**socket-level chaos sites**: transport code passes control points through
+:func:`chaos`, and a test arms a connection fault with
+:func:`inject_chaos` — drop a send, delay it (a stalled shard), or reset
+the connection once (before or after the bytes left, which is the
+difference between "commit lost" and "commit applied but reply lost" —
+the latter is what commit dedup exists for). Like :func:`apply`, the
+hooks consume deterministic ``after``/``count`` budgets, so reconnect,
+dedup, and eviction paths are exercised by scripted injection instead of
+timing luck.
 """
 
 from __future__ import annotations
@@ -87,6 +98,96 @@ def apply(site: str, value: float) -> float:
 
     telemetry.counter("fault.injected", site=site).inc()
     return inj.value
+
+
+# -- socket-level chaos (elastic-fleet test surface) -------------------------
+
+#: Actions a chaos site may be armed with. Semantics are implemented at
+#: the call site (the site knows its socket); this module only meters.
+CHAOS_ACTIONS = ("drop", "delay", "reset", "reset_after_send")
+
+
+class ChaosAction:
+    """One armed transport fault, returned by :func:`chaos` when it fires."""
+
+    __slots__ = ("action", "delay_s")
+
+    def __init__(self, action: str, delay_s: float):
+        self.action = action
+        self.delay_s = delay_s
+
+
+class _ChaosInjection:
+    __slots__ = ("action", "delay_s", "after", "count", "skipped", "fired")
+
+    def __init__(self, action: str, delay_s: float, after: int,
+                 count: Optional[int]):
+        self.action = action
+        self.delay_s = float(delay_s)
+        self.after = int(after)
+        self.count = count
+        self.skipped = 0
+        self.fired = 0
+
+
+_chaos: dict = {}
+
+
+def inject_chaos(site: str, action: str, after: int = 0,
+                 count: Optional[int] = 1, delay_s: float = 0.0) -> None:
+    """Arm a transport fault at ``site``: the first ``after`` passes through
+    :func:`chaos` are clean, then the next ``count`` (default ONE — chaos
+    faults are usually reset-once scripts; None = every subsequent one)
+    return the armed action. Sites in use:
+
+    - ``"remote_ps.send"`` — client request egress
+      (:meth:`RemoteParameterServer._roundtrip`): ``reset`` raises before
+      the bytes leave (request lost), ``reset_after_send`` raises after
+      (request applied server-side, reply lost — the dedup scenario),
+      ``delay`` sleeps ``delay_s`` first, ``drop`` swallows the send so
+      the reply wait hits the per-op timeout.
+    - ``"remote_ps.server.handle"`` — server-side dispatch
+      (:meth:`ParameterServerService._dispatch`): ``delay`` stalls the
+      shard, ``reset`` closes the connection instead of replying.
+    """
+    if action not in CHAOS_ACTIONS:
+        raise ValueError(f"chaos action must be one of {CHAOS_ACTIONS}, "
+                         f"got {action!r}")
+    with _inj_lock:
+        _chaos[site] = _ChaosInjection(action, delay_s, after, count)
+
+
+def clear_chaos(site: Optional[str] = None) -> None:
+    """Disarm one chaos site, or every site (``site=None``) — teardown."""
+    with _inj_lock:
+        if site is None:
+            _chaos.clear()
+        else:
+            _chaos.pop(site, None)
+
+
+def chaos(site: str) -> Optional[ChaosAction]:
+    """Pass a transport control point through the chaos hook for ``site``.
+    Returns the armed :class:`ChaosAction` when this pass fires, else None
+    (always None when nothing is armed — the no-chaos fast path is one
+    dict lookup). Thread-safe; budgets are consumed exactly once."""
+    inj = _chaos.get(site)
+    if inj is None:
+        return None
+    with _inj_lock:
+        inj = _chaos.get(site)
+        if inj is None:
+            return None
+        if inj.skipped < inj.after:
+            inj.skipped += 1
+            return None
+        if inj.count is not None and inj.fired >= inj.count:
+            return None
+        inj.fired += 1
+    from distkeras_tpu import telemetry
+
+    telemetry.counter("fault.chaos", site=site, action=inj.action).inc()
+    return ChaosAction(inj.action, inj.delay_s)
 
 
 def run_with_retries(trainer, dataset, shuffle: bool = False,
