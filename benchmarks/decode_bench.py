@@ -1,0 +1,254 @@
+"""Decode benchmark — generative tokens/s and TTFT across serving modes.
+
+Three ways to serve the same autoregressive workload (R requests with
+mixed prompt lengths and mixed ``max_new_tokens``, ``--slots`` lanes):
+
+- **naive**: no KV cache — every token re-runs the full-prefix forward
+  at the model's max_len padded shape (what generating through the
+  one-shot engine costs today): O(T^2) attention FLOPs per sequence.
+- **static**: KV-cache prefill + decode, but wave batching — a wave of
+  ``slots`` requests decodes in lockstep until the LONGEST one finishes;
+  short sequences waste their lane waiting, and the next wave waits for
+  the whole previous wave.
+- **continuous**: the real :class:`GenerationEngine` — iteration-level
+  admission/retirement over the slot pool (DESIGN.md §14).
+
+Prints one JSON line per mode plus a summary row with the speedup
+ratios (ISSUE 9 acceptance: continuous >= 3x naive tokens/s at
+batch >= 4 on the CPU host). Tokens/s counts USEFUL tokens only
+(requested generations), so padded lanes and lockstep waste show up as
+lost throughput, not inflated numbers. Compile/warmup time is excluded
+from every mode's measured window — this benchmarks steady-state
+serving, not cold start.
+
+Usage:
+  python benchmarks/decode_bench.py [--requests 8] [--slots 4]
+      [--modes naive,static,continuous] [--seed 0]
+
+CPU-safe (gpt_tiny); on a TPU host the same script exercises the device
+path unchanged. JSONL convention matches serving_load.py / step_probe.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+try:
+    import distkeras_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # running from a source checkout: use the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+PREFILL_BUCKETS = (8, 32)
+
+
+def _workload(requests: int, seed: int):
+    """Mixed prompts/targets: the shape continuous batching wins on."""
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, 256, size=int(n)).tolist()
+               for n in rng.integers(4, 32, size=requests)]
+    max_news = [(4, 8, 16, 32)[i % 4] for i in range(requests)]
+    return prompts, max_news
+
+
+def _build_model(seed: int):
+    import jax
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models.gpt import gpt_tiny
+
+    model = gpt_tiny()
+    params = model.init(jax.random.key(seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def run_naive(model, params, prompts, max_news, lanes: int) -> dict:
+    import jax
+
+    fwd = jax.jit(lambda p, ids: model.apply({"params": p}, ids))
+    ml = model.max_len
+    warm = np.zeros((lanes, ml), np.int32)
+    np.asarray(fwd(params, warm))  # compile outside the timed window
+    total = 0
+    ttfts = []
+    t0 = time.perf_counter()
+    for w in range(0, len(prompts), lanes):
+        idx = range(w, min(w + lanes, len(prompts)))
+        seqs = [list(prompts[i]) for i in idx]
+        target = [max_news[i] for i in idx]
+        done = [0] * len(seqs)
+        t_wave = time.perf_counter()
+        first = True
+        while any(d < t for d, t in zip(done, target)):
+            ids = np.zeros((lanes, ml), np.int32)
+            for j, s in enumerate(seqs):
+                ids[j, :len(s)] = s
+            logits = np.asarray(fwd(params, ids))
+            for j, s in enumerate(seqs):
+                if done[j] < target[j]:
+                    s.append(int(np.argmax(logits[j, len(s) - 1])))
+                    done[j] += 1
+                    total += 1
+            if first:
+                ttfts.append(time.perf_counter() - t_wave)
+                first = False
+    wall = time.perf_counter() - t0
+    return {"total_tokens": total, "wall_s": wall,
+            "tokens_per_s": total / wall,
+            "ttft_s_mean": float(np.mean(ttfts))}
+
+
+def run_static(model, params, prompts, max_news, lanes: int) -> dict:
+    """KV-cache decode, wave-lockstep: every executable the continuous
+    engine uses, minus iteration-level scheduling."""
+    import jax
+
+    from distkeras_tpu.serving.buckets import BucketSpec
+    from distkeras_tpu.serving.generation import (make_decode_fn,
+                                                  make_prefill_fn)
+    from distkeras_tpu.serving.kv_cache import KVCachePool
+
+    buckets = BucketSpec(PREFILL_BUCKETS)
+    pool = KVCachePool(model, lanes)
+    sds = lambda tree: jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+    p_sds, pool_sds = sds(params), sds(pool.pool)
+    i32 = lambda *shape: jax.ShapeDtypeStruct(shape, np.int32)
+    prefill = {
+        lb: jax.jit(make_prefill_fn(model), donate_argnums=(1,)).lower(
+            p_sds, pool_sds, i32(1, lb), i32(), i32()).compile()
+        for lb in buckets}
+    decode = jax.jit(make_decode_fn(model), donate_argnums=(1,)).lower(
+        p_sds, pool_sds, i32(lanes), i32(lanes), i32(lanes)).compile()
+    # warmup pass against the scratch row
+    scratch = np.int32(pool.scratch_slot)
+    for lb, ex in prefill.items():
+        new_pool, _ = ex(params, pool.pool, np.zeros((1, lb), np.int32),
+                         scratch, np.int32(lb))
+        pool.swap(new_pool)
+    zeros = np.zeros(lanes, np.int32)
+    new_pool, _ = decode(params, pool.pool,
+                         np.full(lanes, scratch, np.int32), zeros, zeros)
+    pool.swap(new_pool)
+
+    total = 0
+    ttfts = []
+    t0 = time.perf_counter()
+    for w in range(0, len(prompts), lanes):
+        idx = list(range(w, min(w + lanes, len(prompts))))
+        t_wave = time.perf_counter()
+        slots, last, lengths_h, counts = [], [], [], []
+        for i in idx:
+            slot = pool.allocate()
+            n = len(prompts[i])
+            lb = buckets.bucket_for(n)
+            ids = np.zeros((1, lb), np.int32)
+            ids[0, :n] = prompts[i]
+            new_pool, logits = prefill[lb](params, pool.pool, ids,
+                                           np.int32(slot), np.int32(n))
+            pool.swap(new_pool)
+            pool.lengths[slot] = n
+            slots.append(slot)
+            last.append(int(np.argmax(np.asarray(logits))))
+            counts.append(1)
+            total += 1
+        ttfts.append(time.perf_counter() - t_wave)
+        # lockstep decode until the wave's LONGEST request finishes;
+        # finished lanes idle on the scratch row (the static-batching tax)
+        while any(counts[j] < max_news[i] for j, i in enumerate(idx)):
+            slot_ids = np.full(lanes, pool.scratch_slot, np.int32)
+            tokens = np.zeros(lanes, np.int32)
+            lengths = np.zeros(lanes, np.int32)
+            live = [j for j, i in enumerate(idx)
+                    if counts[j] < max_news[i]]
+            for row, j in enumerate(live):
+                slot_ids[row] = slots[j]
+                tokens[row] = last[j]
+                lengths[row] = pool.lengths[slots[j]]
+            new_pool, logits = decode(params, pool.pool, slot_ids, tokens,
+                                      lengths)
+            pool.swap(new_pool)
+            logits = np.asarray(logits)
+            for row, j in enumerate(live):
+                pool.lengths[slots[j]] += 1
+                last[j] = int(np.argmax(logits[row]))
+                counts[j] += 1
+                total += 1
+        for slot in slots:
+            pool.free(slot)
+    wall = time.perf_counter() - t0
+    return {"total_tokens": total, "wall_s": wall,
+            "tokens_per_s": total / wall,
+            "ttft_s_mean": float(np.mean(ttfts))}
+
+
+def run_continuous(model, params, prompts, max_news, lanes: int) -> dict:
+    from distkeras_tpu.serving.generation import GenerationEngine
+
+    eng = GenerationEngine(model, params, num_slots=lanes,
+                           prefill_buckets=PREFILL_BUCKETS,
+                           queue_capacity=max(64, len(prompts)))
+    try:
+        t_first = {}
+        t0 = time.perf_counter()
+        futs = []
+        for i, p in enumerate(prompts):
+            stream = (lambda tok, i=i: t_first.setdefault(
+                i, time.perf_counter() - t0))
+            futs.append(eng.generate(p, max_new_tokens=max_news[i],
+                                     stream=stream))
+        total = sum(f.result(timeout=600).tokens.size for f in futs)
+        wall = time.perf_counter() - t0
+    finally:
+        eng.shutdown()
+    return {"total_tokens": total, "wall_s": wall,
+            "tokens_per_s": total / wall,
+            "ttft_s_mean": float(np.mean(list(t_first.values())))}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--modes", default="naive,static,continuous")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    model, params = _build_model(args.seed)
+    prompts, max_news = _workload(args.requests, args.seed)
+    runners = {"naive": run_naive, "static": run_static,
+               "continuous": run_continuous}
+    base = {"bench": "decode", "requests": args.requests,
+            "slots": args.slots, "platform": jax.default_backend(),
+            "model": "gpt_tiny", "seed": args.seed}
+    results = {}
+    for mode in args.modes.split(","):
+        mode = mode.strip()
+        row = dict(base, mode=mode,
+                   **runners[mode](model, params, prompts, max_news,
+                                   args.slots))
+        results[mode] = row
+        print(json.dumps(row))
+    if "naive" in results and "continuous" in results:
+        summary = dict(base, mode="summary",
+                       speedup_vs_naive=results["continuous"]["tokens_per_s"]
+                       / results["naive"]["tokens_per_s"])
+        if "static" in results:
+            summary["speedup_vs_static"] = (
+                results["continuous"]["tokens_per_s"]
+                / results["static"]["tokens_per_s"])
+        print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

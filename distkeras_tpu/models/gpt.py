@@ -15,6 +15,22 @@ story: a GPT-style decoder whose attention can run either
 
 Both paths share weights: a model trained sequence-parallel serves
 single-device and vice versa.
+
+Decode mode (generative serving, DESIGN.md §14): every module also
+accepts ``cache``/``cache_index``. The cache is a per-layer
+``{"k", "v"}`` pytree of ``[batch, max_len, heads, head_dim]`` arrays
+(see :func:`init_cache`); ``cache_index[b]`` is the number of tokens
+already cached for row ``b``, i.e. the position of this call's first
+input token. The module writes the block's K/V into the cache and
+attends over the FULL fixed-length cache with positions
+``>= cache_index + q`` masked to exact-zero softmax weight, then
+returns ``(logits, new_cache)``. One code path covers both phases:
+prefill is a T-token call at ``cache_index=0``, decode a T=1 call at
+``cache_index=lengths``. Because the attention contraction always runs
+over ``max_len`` keys with an exact-zero tail, decode logits are
+bitwise-equal (f32) to the standard full forward evaluated at the same
+``max_len`` padded shape (NUMERICS.md "Decode-step equivalence");
+cache mode requires ``attention="full"``.
 """
 
 from __future__ import annotations
@@ -24,6 +40,7 @@ from typing import Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from distkeras_tpu import precision as precision_lib
 from distkeras_tpu.models.remat import remat_wrap
@@ -40,7 +57,7 @@ class CausalSelfAttention(nn.Module):
     precision: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, cache=None, cache_index=None):
         dtype, dense_kw, _, _ = precision_lib.resolve(self.precision,
                                                       self.dtype)
         width = x.shape[-1]
@@ -49,6 +66,29 @@ class CausalSelfAttention(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         split = lambda t: t.reshape(t.shape[:2] + (self.num_heads, head_dim))
         q, k, v = split(q), split(k), split(v)
+        if cache is not None:
+            if self.attention != "full":
+                raise ValueError(
+                    f"KV-cache decode requires attention='full', got "
+                    f"{self.attention!r}")
+            b, t = x.shape[:2]
+            rows = jnp.arange(b)[:, None]
+            pos = cache_index[:, None] + jnp.arange(t)[None, :]  # [b, t]
+            # mode="drop": a ghost position past max_len-1 (the decode
+            # step's gemm-path padding, DESIGN.md §14) must not clamp onto
+            # the last real cell
+            k_cache = cache["k"].at[rows, pos].set(k, mode="drop")
+            v_cache = cache["v"].at[rows, pos].set(v, mode="drop")
+            # causal across history + block: key p visible to query j iff
+            # p <= cache_index + j; masked keys get exact-zero softmax
+            # weight (MASK_VALUE underflows), so the fixed-length
+            # contraction matches the max_len-padded full forward bitwise
+            key_pos = jnp.arange(k_cache.shape[1])
+            mask = key_pos[None, None, None, :] <= pos[:, None, :, None]
+            out = dot_product_attention(q, k_cache, v_cache, mask=mask)
+            out = out.reshape(out.shape[:2] + (width,))
+            out = nn.Dense(width, dtype=dtype, name="out", **dense_kw)(out)
+            return out, {"k": k_cache, "v": v_cache}
         if self.attention == "ring":
             out = ring_attention(q, k, v, axis_name=self.axis_name,
                                  causal=True)
@@ -75,17 +115,22 @@ class DecoderBlock(nn.Module):
     precision: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, cache=None, cache_index=None):
         dtype = precision_lib.resolve(self.precision, self.dtype)[0]
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(dtype)
-        y = CausalSelfAttention(self.num_heads, self.dtype, self.attention,
-                                self.axis_name, precision=self.precision,
-                                name="attn")(y)
+        attn = CausalSelfAttention(self.num_heads, self.dtype, self.attention,
+                                   self.axis_name, precision=self.precision,
+                                   name="attn")
+        if cache is not None:
+            y, new_cache = attn(y, cache, cache_index)
+        else:
+            y, new_cache = attn(y), None
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(dtype)
         y = MlpBlock(self.mlp_dim, 0.0, self.dtype,
                      precision=self.precision, name="mlp")(y, train=train)
-        return x + y
+        x = x + y
+        return x if new_cache is None else (x, new_cache)
 
 
 class CausalLM(nn.Module):
@@ -106,7 +151,8 @@ class CausalLM(nn.Module):
     precision: Optional[str] = None
 
     @nn.compact
-    def __call__(self, input_ids, train: bool = False):
+    def __call__(self, input_ids, train: bool = False, cache=None,
+                 cache_index=None):
         dtype = precision_lib.resolve(self.precision, self.dtype)[0]
         ids = input_ids.astype(jnp.int32)
         b, t = ids.shape  # t = LOCAL block length under sequence parallelism
@@ -115,6 +161,24 @@ class CausalLM(nn.Module):
                       name="tok_embed")(ids)
         pos_table = self.param("pos_embed", nn.initializers.normal(0.02),
                                (self.max_len, self.width))
+        if cache is not None:
+            # decode mode: positions come from each row's cache cursor;
+            # blocks run un-rematted (inference) but with identical param
+            # structure, so trained checkpoints serve as-is
+            pos = pos_table[cache_index[:, None] + jnp.arange(t)[None, :]]
+            x = x + pos.astype(dtype)
+            new_cache = []
+            for i in range(self.num_layers):
+                x, layer_cache = DecoderBlock(
+                    self.num_heads, self.mlp_dim, self.dtype,
+                    self.attention, self.axis_name,
+                    precision=self.precision, name=f"layer_{i}")(
+                        x, train, cache=cache[i], cache_index=cache_index)
+                new_cache.append(layer_cache)
+            x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+            logits = nn.Dense(self.vocab_size, dtype=jnp.float32,
+                              name="lm_head")(x)
+            return logits.astype(jnp.float32), tuple(new_cache)
         if self.attention == "ring":
             # global positions of this device's block. psum(1) over the mesh
             # axis is concrete at trace time, so this bound check is static —
@@ -141,6 +205,31 @@ class CausalLM(nn.Module):
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,
                           name="lm_head")(x)
         return logits.astype(jnp.float32)
+
+
+def init_cache(model: CausalLM, batch: int, dtype=None):
+    """Zeroed per-layer K/V cache for ``batch`` rows of ``model.max_len``
+    context: a tuple (one entry per layer) of ``{"k", "v"}`` arrays shaped
+    ``[batch, max_len, num_heads, head_dim]`` in the model's resolved
+    compute dtype (K/V are produced by the qkv projection, which runs in
+    that dtype). ~``2 * layers * max_len * width * itemsize`` bytes per
+    row — the number the serving slot pool budgets against."""
+    if dtype is None:
+        dtype = precision_lib.resolve(model.precision, model.dtype)[0]
+    head_dim = model.width // model.num_heads
+    shape = (batch, model.max_len, model.num_heads, head_dim)
+    return tuple({"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+                 for _ in range(model.num_layers))
+
+
+def cache_bytes_per_row(model: CausalLM, dtype=None) -> int:
+    """HBM bytes one cache slot costs (k + v, every layer) — the unit the
+    KV-cache manager's budget check multiplies by ``num_slots``."""
+    if dtype is None:
+        dtype = precision_lib.resolve(model.precision, model.dtype)[0]
+    head_dim = model.width // model.num_heads
+    per_tensor = model.max_len * model.num_heads * head_dim
+    return 2 * model.num_layers * per_tensor * np.dtype(dtype).itemsize
 
 
 def gpt_small(**kw) -> CausalLM:

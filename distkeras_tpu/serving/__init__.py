@@ -12,6 +12,17 @@ for semantics and telemetry names.
     fut = eng.submit(row)          # concurrent.futures.Future
     logits = fut.result()
     eng.shutdown(drain=True)
+
+Generative serving (KV-cache decode + continuous batching, DESIGN.md
+§14) lives in generation.py / kv_cache.py:
+
+    from distkeras_tpu.serving import GenerationEngine
+
+    gen = GenerationEngine(model, params, num_slots=8,
+                           prefill_buckets=(8, 32), eos_id=eos)
+    fut = gen.generate(prompt, max_new_tokens=64, stream=print)
+    result = fut.result()          # GenerationResult(tokens, reason)
+    gen.shutdown()
 """
 
 from distkeras_tpu.serving.batching import (
@@ -23,6 +34,11 @@ from distkeras_tpu.serving.batching import (
 )
 from distkeras_tpu.serving.buckets import DEFAULT_BUCKETS, BucketSpec
 from distkeras_tpu.serving.engine import ServingEngine
+from distkeras_tpu.serving.generation import (
+    GenerationEngine,
+    GenerationResult,
+)
+from distkeras_tpu.serving.kv_cache import KVCachePool
 from distkeras_tpu.serving.server import ServingClient, ServingServer
 
 __all__ = [
@@ -30,6 +46,9 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "DeadlineExceeded",
     "EngineClosed",
+    "GenerationEngine",
+    "GenerationResult",
+    "KVCachePool",
     "QueueFull",
     "Request",
     "RequestQueue",

@@ -143,6 +143,11 @@ class ServingEngine:
 
         self._compiled: dict = {}          # bucket size -> AOT executable
         self._compile_lock = threading.Lock()
+        # bucket -> reusable host staging buffer. Owned by the batcher
+        # thread (single consumer); _execute blocks on the batch's device
+        # result before returning, so the buffer is never mutated while a
+        # forward still reads it.
+        self._staging: dict = {}
         self._queue = RequestQueue(queue_capacity)
         self._submitted = telemetry.counter("serving.submitted")
         self._completed = telemetry.counter("serving.completed")
@@ -233,6 +238,7 @@ class ServingEngine:
                                            self.max_wait_s)
             if batch is None:
                 return  # closed and drained
+            self._refresh_queue_gauges()  # live without a health poll
             if not batch:
                 continue  # every popped request had expired
             try:
@@ -246,7 +252,12 @@ class ServingEngine:
     def _execute(self, batch):
         n = len(batch)
         bucket = self.spec.bucket_for(n)
-        x = np.zeros((bucket,) + self.input_shape, self.input_dtype)
+        x = self._staging.get(bucket)
+        if x is None:
+            x = np.zeros((bucket,) + self.input_shape, self.input_dtype)
+            self._staging[bucket] = x
+        else:
+            x[n:] = 0  # zero only the padded tail; live rows get overwritten
         for i, req in enumerate(batch):
             x[i] = req.x
         self._padding.record(bucket - n)
@@ -268,16 +279,21 @@ class ServingEngine:
         self._completed.inc(n)
 
     # -- health -----------------------------------------------------------
-    def health_status(self) -> dict:
-        """Live queue state for the health plane: depth, head-of-line age,
-        compile-cache contents. Also refreshes the ``serving.queue_depth``
-        and ``serving.oldest_request_age_s`` gauges so a metrics snapshot
-        taken between submits reflects the queue as of this call."""
+    def _refresh_queue_gauges(self) -> Tuple[int, Optional[float]]:
+        """Push queue depth + head-of-line age into the gauges. Called
+        from the batcher loop after every pop AND from health_status, so
+        metrics snapshots are live without a health poll."""
         depth = len(self._queue)
         age = self._queue.oldest_age()
         telemetry.gauge("serving.queue_depth").set(depth)
         telemetry.gauge("serving.oldest_request_age_s").set(
             0.0 if age is None else age)
+        return depth, age
+
+    def health_status(self) -> dict:
+        """Live queue state for the health plane: depth, head-of-line age,
+        compile-cache contents."""
+        depth, age = self._refresh_queue_gauges()
         return {
             "queue_depth": depth,
             "oldest_request_age_s": age,
@@ -300,6 +316,14 @@ class ServingEngine:
             self._queue.fail_pending(
                 EngineClosed("engine shut down without draining"))
         self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            # the join timed out: a wedged batch is still holding the
+            # batcher. Don't leave submitters hanging forever — fail
+            # whatever is still queued and make the timeout observable.
+            telemetry.counter("serving.shutdown_timeouts").inc()
+            self._queue.fail_pending(EngineClosed(
+                f"batcher thread still running after {timeout}s "
+                f"shutdown join"))
         if self.telemetry_path:
             reg = telemetry.get_registry()
             if reg is not None:

@@ -1,0 +1,556 @@
+"""Continuous-batching generative serving (DESIGN.md §14).
+
+The one-shot engine (engine.py) answers fixed-shape forwards; generating
+T tokens through it costs T full-prefix forwards — O(T^2) attention
+FLOPs recomputed per request and a compile-cache entry per observed
+length. This module is the autoregressive path done properly:
+
+- **prefill**: one bucketed forward (existing :class:`BucketSpec`
+  ladder over prompt lengths) writes the whole prompt's K/V into a
+  pool slot (serving/kv_cache.py) and yields the first token;
+- **decode**: every iteration advances ALL in-flight sequences by one
+  token in a single compiled step, the batch padded up to a declared
+  **slot ladder** entry;
+- **iteration-level scheduling** (the Orca/vLLM idea): new requests are
+  admitted into the in-flight batch between decode steps, and finished
+  sequences (EOS / ``max_new_tokens`` / deadline / context full) retire
+  mid-flight, freeing their slot immediately — a short request admitted
+  after a long one finishes first instead of waiting for the batch.
+
+Compile-cache discipline survives verbatim from PR 2: exactly one
+prefill executable per prompt bucket and one decode executable per
+ladder entry, all AOT-compiled in ``__init__`` — the cache can never
+grow under traffic (asserted in tests/test_generation.py).
+
+Numerics: decode logits are bitwise-equal (f32) to the full-prefix
+forward at the model's ``max_len``-padded shape, at every step. Two
+tricks make that hold (NUMERICS.md "Decode-step equivalence"): the
+attention contraction always runs over all ``max_len`` keys with an
+exact-zero masked tail, and each decode step feeds a **ghost position**
+— a T=2 block ``[token, 0]`` — because XLA:CPU's M=1 matmul (gemv)
+path associates the K-reduction differently from the M>=2 gemm path.
+The ghost's query output is discarded and its cache write never leaves
+the step (only the real cell is scattered back to the pool).
+
+Backpressure/deadline semantics are PR 2's, with the same typed errors:
+bounded admission queue (:class:`QueueFull`, all-or-nothing), deadlines
+checked at admission AND between decode steps (:class:`DeadlineExceeded`
+mid-generation frees the slot), :class:`EngineClosed` after shutdown.
+
+Greedy (argmax) decoding only, on the host — sampling policies and
+paged attention are honest limits, DESIGN.md §14.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.serving.batching import (DeadlineExceeded, EngineClosed,
+                                            QueueFull)
+from distkeras_tpu.serving.buckets import BucketSpec
+from distkeras_tpu.serving.kv_cache import KVCachePool
+
+#: token id fed at the decode step's ghost position (its output is
+#: discarded and its cache write dropped, so any valid id works)
+GHOST_TOKEN = 0
+
+
+def _default_ladder(num_slots: int) -> Tuple[int, ...]:
+    """Powers of two up to ``num_slots``, always ending at ``num_slots``
+    so every possible in-flight count has a lane bucket."""
+    sizes = set()
+    n = 1
+    while n < num_slots:
+        sizes.add(n)
+        n *= 2
+    sizes.add(num_slots)
+    return tuple(sorted(sizes))
+
+
+def make_prefill_fn(model):
+    """Pure ``(params, pool, ids[1, Lb], slot, length) -> (pool',
+    last_logits[V])``: write the prompt's K/V into pool row ``slot`` and
+    return the logits at position ``length - 1`` (the first-token
+    distribution). Bucket padding beyond ``length`` writes cells the
+    length mask hides until real tokens overwrite them."""
+    import jax
+    import jax.numpy as jnp
+
+    def prefill(params, pool, ids, slot, length):
+        row = jax.tree.map(
+            lambda a: jnp.zeros((1,) + a.shape[1:], a.dtype), pool)
+        logits, new_row = model.apply(
+            {"params": params}, ids, cache=row,
+            cache_index=jnp.zeros((1,), jnp.int32))
+        pool = jax.tree.map(
+            lambda p, c: jax.lax.dynamic_update_slice_in_dim(
+                p, c, slot, axis=0), pool, new_row)
+        return pool, logits[0, length - 1]
+
+    return prefill
+
+
+def make_decode_fn(model):
+    """Pure ``(params, pool, slot_ids[n], tokens[n], lengths[n]) ->
+    (pool', logits[n, V])``: advance ``n`` lanes one token. Each lane
+    feeds ``[token, GHOST_TOKEN]`` at positions ``[len, len+1]`` (the
+    ghost keeps every matmul on the gemm path — see module docstring);
+    only the real position's new K/V cell is scattered back, and only
+    its logits returned. Padded lanes point at the pool's scratch row
+    with length 0; their writes land in scratch and their outputs are
+    discarded by the caller."""
+    import jax
+    import jax.numpy as jnp
+
+    def decode(params, pool, slot_ids, tokens, lengths):
+        n = slot_ids.shape[0]
+        rows = jax.tree.map(lambda a: a[slot_ids], pool)
+        ids = jnp.stack(
+            [tokens, jnp.full_like(tokens, GHOST_TOKEN)], axis=1)
+        logits, new_rows = model.apply(
+            {"params": params}, ids, cache=rows, cache_index=lengths)
+        lane = jnp.arange(n)
+        # scatter back ONLY the real cell [slot, len]; the ghost cell
+        # never reaches the pool. Scratch-lane duplicates collide only
+        # with each other on the scratch row (mode="drop" is for a real
+        # cell at max_len-1 whose ghost would otherwise clamp).
+        pool = jax.tree.map(
+            lambda p, c: p.at[slot_ids, lengths].set(
+                c[lane, lengths], mode="drop"), pool, new_rows)
+        return pool, logits[:, 0, :]
+
+    return decode
+
+
+class GenerationResult:
+    """Terminal value of a finished generation.
+
+    ``tokens``: int32 array of generated tokens (includes the EOS token
+    when ``reason == "eos"``). ``reason``: ``"eos"`` | ``"length"``
+    (hit ``max_new_tokens``) | ``"max_len"`` (context window full).
+    """
+
+    __slots__ = ("tokens", "reason")
+
+    def __init__(self, tokens: np.ndarray, reason: str):
+        self.tokens = tokens
+        self.reason = reason
+
+    def __repr__(self) -> str:
+        return (f"GenerationResult(tokens={self.tokens.tolist()}, "
+                f"reason={self.reason!r})")
+
+
+class _GenRequest:
+    __slots__ = ("prompt", "max_new_tokens", "eos_id", "stream", "future",
+                 "t_submit", "deadline", "generated", "last_token")
+
+    def __init__(self, prompt, max_new_tokens, eos_id, stream,
+                 t_submit, deadline):
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.stream = stream
+        self.future: Future = Future()
+        self.t_submit = t_submit
+        self.deadline = deadline
+        self.generated: list = []
+        self.last_token: int = 0
+
+
+class GenerationEngine:
+    """Iteration-level continuous-batching decode loop over a slot pool.
+
+    ``generate()`` is thread-safe and returns a Future of
+    :class:`GenerationResult`; an optional ``stream`` callback receives
+    each token as it is emitted (called on the scheduler thread — it
+    must not block, or every in-flight sequence stalls).
+
+    One scheduler thread owns the pool, the compiled executables, and
+    all host-side accounting; every loop iteration admits queued
+    requests into free slots (prefill), advances all active lanes one
+    token (decode), and retires finished sequences.
+    """
+
+    def __init__(self, model, params, *, num_slots: int = 4,
+                 slot_ladder: Optional[Sequence[int]] = None,
+                 prefill_buckets: Sequence[int] = (8, 32),
+                 queue_capacity: int = 64,
+                 default_max_new_tokens: int = 32,
+                 eos_id: Optional[int] = None,
+                 device=None, dtype=None, hbm_fraction: float = 0.8,
+                 warmup: bool = True):
+        import jax
+
+        self.model = model
+        self.max_len = int(model.max_len)
+        self._buckets = BucketSpec(prefill_buckets)
+        if self._buckets.sizes[0] < 2:
+            # Lb=1 would put the prefill Dense on the M=1 gemv path and
+            # break decode-step bitwise parity (module docstring)
+            raise ValueError(
+                f"prefill buckets must be >= 2, got {self._buckets.sizes}")
+        if self._buckets.max_size > self.max_len:
+            raise ValueError(
+                f"largest prefill bucket {self._buckets.max_size} exceeds "
+                f"model max_len {self.max_len}")
+        self._ladder = BucketSpec(
+            _default_ladder(num_slots) if slot_ladder is None
+            else slot_ladder)
+        if self._ladder.max_size != num_slots:
+            raise ValueError(
+                f"slot ladder {self._ladder.sizes} must top out at "
+                f"num_slots={num_slots} so every in-flight count has a "
+                f"compiled lane width")
+        self.pool = KVCachePool(model, num_slots, device=device,
+                                dtype=dtype, hbm_fraction=hbm_fraction)
+        if device is not None:
+            params = jax.device_put(params, device)
+        self._params = params
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.eos_id = eos_id
+        self.queue_capacity = int(queue_capacity)
+        self._dq: "collections.deque[_GenRequest]" = collections.deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self._drain = True
+
+        self._admitted_c = telemetry.counter("serving.decode.admitted")
+        self._rejected_c = telemetry.counter("serving.decode.rejected")
+        self._expired_c = telemetry.counter("serving.decode.deadline_exceeded")
+        self._prefills_c = telemetry.counter("serving.decode.prefills")
+        self._steps_c = telemetry.counter("serving.decode.steps")
+        self._tokens_c = telemetry.counter("serving.decode.tokens")
+        self._stream_err_c = telemetry.counter("serving.decode.stream_errors")
+        self._loop_err_c = telemetry.counter("serving.decode.loop_errors")
+        self._prefill_h = telemetry.histogram("serving.decode.prefill_s")
+        self._step_h = telemetry.histogram("serving.decode.step_s")
+        self._ttft_h = telemetry.histogram("serving.decode.ttft_s")
+        self._padded_h = telemetry.histogram("serving.decode.padded_lanes")
+        self._tps_g = telemetry.gauge("serving.decode.tokens_per_s")
+        self._active_g = telemetry.gauge("serving.decode.slots_active")
+        self._depth_g = telemetry.gauge("serving.decode.queue_depth")
+
+        self._compile_all()
+        if warmup:
+            self._warmup()
+        self._thread = threading.Thread(target=self._scheduler_loop,
+                                        name="generation-scheduler",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- AOT compilation ---------------------------------------------------
+
+    def _compile_all(self) -> None:
+        """Compile exactly one executable per prefill bucket and one per
+        slot-ladder entry, up front. Nothing compiles after __init__ —
+        the cache cannot grow under traffic (asserted by test)."""
+        import jax
+
+        sds = lambda tree: jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+        p_sds, pool_sds = sds(self._params), sds(self.pool.pool)
+        i32 = lambda *shape: jax.ShapeDtypeStruct(shape, np.int32)
+        prefill = make_prefill_fn(self.model)
+        decode = make_decode_fn(self.model)
+        self._prefill_exec = {}
+        self._decode_exec = {}
+        for lb in self._buckets:
+            with telemetry.span("serving.decode.compile", prefill=lb):
+                self._prefill_exec[lb] = jax.jit(
+                    prefill, donate_argnums=(1,)).lower(
+                        p_sds, pool_sds, i32(1, lb), i32(), i32()).compile()
+            telemetry.counter("serving.decode.compiles").inc()
+        for n in self._ladder:
+            with telemetry.span("serving.decode.compile", lanes=n):
+                self._decode_exec[n] = jax.jit(
+                    decode, donate_argnums=(1,)).lower(
+                        p_sds, pool_sds, i32(n), i32(n), i32(n)).compile()
+            telemetry.counter("serving.decode.compiles").inc()
+
+    def _warmup(self) -> None:
+        """Run every executable once against the scratch slot so no
+        request pays first-execution costs. Scratch garbage is fine:
+        reads are masked by per-slot lengths."""
+        with telemetry.span("serving.decode.warmup"):
+            scratch = np.int32(self.pool.scratch_slot)
+            for lb, ex in self._prefill_exec.items():
+                new_pool, _ = ex(self._params, self.pool.pool,
+                                 np.zeros((1, lb), np.int32), scratch,
+                                 np.int32(lb))
+                self.pool.swap(new_pool)
+            for n, ex in self._decode_exec.items():
+                lanes = np.full(n, scratch, np.int32)
+                zeros = np.zeros(n, np.int32)
+                new_pool, _ = ex(self._params, self.pool.pool, lanes,
+                                 zeros, zeros)
+                self.pool.swap(new_pool)
+
+    @property
+    def compiled_executables(self):
+        """{"prefill": bucket sizes, "decode": lane widths} actually
+        compiled — tests assert this equals the declared ladders and
+        never grows."""
+        return {"prefill": tuple(sorted(self._prefill_exec)),
+                "decode": tuple(sorted(self._decode_exec))}
+
+    # -- client API --------------------------------------------------------
+
+    def generate(self, prompt, *, max_new_tokens: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 timeout_ms: Optional[float] = None,
+                 stream=None) -> Future:
+        """Queue one prompt; returns a Future of :class:`GenerationResult`.
+
+        Raises :class:`QueueFull` when the admission queue is at
+        capacity (slot exhaustion surfaces HERE, as backpressure, never
+        as a device OOM) and :class:`EngineClosed` after shutdown.
+        """
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        if prompt.size > self._buckets.max_size:
+            raise ValueError(
+                f"prompt length {prompt.size} exceeds the largest prefill "
+                f"bucket {self._buckets.max_size}")
+        mnt = (self.default_max_new_tokens if max_new_tokens is None
+               else int(max_new_tokens))
+        if mnt < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {mnt}")
+        if prompt.size + mnt > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({mnt}) exceeds "
+                f"max_len {self.max_len}")
+        now = time.monotonic()
+        deadline = None if timeout_ms is None else now + timeout_ms / 1e3
+        req = _GenRequest(prompt, mnt,
+                          self.eos_id if eos_id is None else eos_id,
+                          stream, now, deadline)
+        with self._cv:
+            if self._closed:
+                raise EngineClosed("engine is shut down; no new requests")
+            if len(self._dq) >= self.queue_capacity:
+                self._rejected_c.inc()
+                raise QueueFull(
+                    f"generation queue at {len(self._dq)}/"
+                    f"{self.queue_capacity}")
+            self._dq.append(req)
+            self._depth_g.set(len(self._dq))
+            self._cv.notify()
+        return req.future
+
+    # -- scheduler ---------------------------------------------------------
+
+    def _scheduler_loop(self) -> None:
+        active = {}  # slot -> _GenRequest
+        try:
+            while True:
+                with self._cv:
+                    while not self._dq and not active and not self._closed:
+                        self._cv.wait()
+                    if self._closed and not self._drain:
+                        pending = list(self._dq)
+                        self._dq.clear()
+                        self._depth_g.set(0)
+                        break
+                    if self._closed and not self._dq and not active:
+                        return
+                self._admit(active)
+                self._expire(active)
+                if active:
+                    self._decode_step(active)
+        except BaseException as e:  # scheduler must never die silently
+            self._loop_err_c.inc()
+            with self._cv:
+                self._closed = True
+                pending = list(self._dq)
+                self._dq.clear()
+                self._depth_g.set(0)
+            err = EngineClosed(f"generation scheduler failed: {e!r}")
+            for req in pending + list(active.values()):
+                req.future.set_exception(err)
+            for slot in list(active):
+                self.pool.free(slot)
+            raise
+        # non-draining shutdown: fail everything still in flight
+        err = EngineClosed("engine shut down without draining")
+        for req in pending + list(active.values()):
+            req.future.set_exception(err)
+        for slot in list(active):
+            self.pool.free(slot)
+        self._active_g.set(0)
+
+    def _admit(self, active) -> None:
+        """Move queued requests into free slots (prefill each). Runs
+        every iteration — admission interleaves with in-flight decode."""
+        while self.pool.num_free > 0:
+            with self._cv:
+                if not self._dq:
+                    return
+                req = self._dq.popleft()
+                self._depth_g.set(len(self._dq))
+            now = time.monotonic()
+            if req.deadline is not None and now > req.deadline:
+                self._expired_c.inc()
+                req.future.set_exception(DeadlineExceeded(
+                    f"deadline passed {1e3 * (now - req.deadline):.1f} ms "
+                    f"before admission"))
+                continue
+            slot = self.pool.allocate()
+            self._prefill(req, slot)
+            self._admitted_c.inc()
+            if self._emit(req, slot) is None:
+                active[slot] = req
+            self._active_g.set(len(active))
+
+    def _prefill(self, req: _GenRequest, slot: int) -> None:
+        n = req.prompt.size
+        lb = self._buckets.bucket_for(n)
+        ids = np.zeros((1, lb), np.int32)
+        ids[0, :n] = req.prompt
+        t0 = time.monotonic()
+        new_pool, logits = self._prefill_exec[lb](
+            self._params, self.pool.pool, ids, np.int32(slot), np.int32(n))
+        self.pool.swap(new_pool)
+        self.pool.lengths[slot] = n
+        tok = int(np.argmax(np.asarray(logits)))
+        now = time.monotonic()
+        self._prefills_c.inc()
+        self._prefill_h.record(now - t0)
+        self._ttft_h.record(now - req.t_submit)
+        req.generated.append(tok)
+        req.last_token = tok
+        self._stream_token(req, tok)
+
+    def _decode_step(self, active) -> None:
+        slots = sorted(active)
+        n = len(slots)
+        lane = self._ladder.bucket_for(n)
+        scratch = self.pool.scratch_slot
+        slot_ids = np.full(lane, scratch, np.int32)
+        tokens = np.full(lane, GHOST_TOKEN, np.int32)
+        lengths = np.zeros(lane, np.int32)
+        for i, s in enumerate(slots):
+            slot_ids[i] = s
+            tokens[i] = active[s].last_token
+            lengths[i] = self.pool.lengths[s]
+        t0 = time.monotonic()
+        new_pool, logits = self._decode_exec[lane](
+            self._params, self.pool.pool, slot_ids, tokens, lengths)
+        self.pool.swap(new_pool)
+        logits = np.asarray(logits)  # blocks until the step lands
+        dt = time.monotonic() - t0
+        self._steps_c.inc()
+        self._tokens_c.inc(n)
+        self._step_h.record(dt)
+        self._padded_h.record(lane - n)
+        if dt > 0:
+            self._tps_g.set(n / dt)
+        for i, s in enumerate(slots):
+            req = active[s]
+            self.pool.lengths[s] += 1  # the fed token is now cached
+            tok = int(np.argmax(logits[i]))
+            req.generated.append(tok)
+            req.last_token = tok
+            self._stream_token(req, tok)
+            reason = self._emit(req, s)
+            if reason is not None:
+                del active[s]
+        self._active_g.set(len(active))
+
+    def _emit(self, req: _GenRequest, slot: int) -> Optional[str]:
+        """After a token lands, decide retirement. Returns the reason
+        when the sequence finished (slot already freed), else None."""
+        tok = req.last_token
+        if req.eos_id is not None and tok == req.eos_id:
+            reason = "eos"
+        elif len(req.generated) >= req.max_new_tokens:
+            reason = "length"
+        elif self.pool.lengths[slot] >= self.max_len:
+            # next feed would write at position max_len — context full
+            reason = "max_len"
+        else:
+            return None
+        self.pool.free(slot)
+        telemetry.counter("serving.decode.retired", reason=reason).inc()
+        req.future.set_result(
+            GenerationResult(np.asarray(req.generated, np.int32), reason))
+        return reason
+
+    def _expire(self, active) -> None:
+        """Fail in-flight sequences whose deadline passed mid-generation;
+        their slots free immediately (the mid-flight retirement path)."""
+        now = time.monotonic()
+        for slot in list(active):
+            req = active[slot]
+            if req.deadline is not None and now > req.deadline:
+                del active[slot]
+                self.pool.free(slot)
+                self._expired_c.inc()
+                telemetry.counter("serving.decode.retired",
+                                  reason="deadline").inc()
+                req.future.set_exception(DeadlineExceeded(
+                    f"deadline passed after {len(req.generated)} tokens"))
+        self._active_g.set(len(active))
+
+    def _stream_token(self, req: _GenRequest, tok: int) -> None:
+        if req.stream is None:
+            return
+        try:
+            req.stream(tok)
+        except Exception:
+            # a broken consumer must not stall every in-flight sequence
+            self._stream_err_c.inc()
+            req.stream = None
+
+    # -- health / lifecycle ------------------------------------------------
+
+    def health_status(self) -> dict:
+        with self._cv:
+            depth = len(self._dq)
+            oldest = (time.monotonic() - self._dq[0].t_submit
+                      if self._dq else 0.0)
+        self._depth_g.set(depth)
+        return {
+            "num_slots": self.pool.num_slots,
+            "slots_active": self.pool.num_active,
+            "slots_free": self.pool.num_free,
+            "queue_depth": depth,
+            "oldest_request_age_s": oldest,
+            "cache_bytes": self.pool.cache_bytes,
+            "prefill_buckets": list(self._buckets.sizes),
+            "decode_ladder": list(self._ladder.sizes),
+            "compiled": {k: list(v) for k, v in
+                         self.compiled_executables.items()},
+        }
+
+    def shutdown(self, drain: bool = True, timeout: float = 60.0) -> None:
+        with self._cv:
+            self._closed = True
+            self._drain = drain
+            self._cv.notify_all()
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            telemetry.counter("serving.shutdown_timeouts").inc()
+            with self._cv:
+                pending = list(self._dq)
+                self._dq.clear()
+                self._depth_g.set(0)
+            err = EngineClosed(
+                f"scheduler still running after {timeout}s shutdown join")
+            for req in pending:
+                req.future.set_exception(err)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False

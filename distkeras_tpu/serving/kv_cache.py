@@ -1,0 +1,134 @@
+"""KV-cache slot pool for generative serving (DESIGN.md §14).
+
+One device-resident pytree holds the K/V cache for every in-flight
+sequence: per layer, ``{"k", "v"}`` arrays shaped
+``[num_slots + 1, max_len, heads, head_dim]``. Row ``s < num_slots`` is
+*slot s* — one sequence's full-context cache, written by the prefill and
+decode executables at positions ``< lengths[s]``. The extra last row is
+the **scratch slot**: padded decode lanes (the slot ladder pads the
+in-flight batch up to a compiled lane count) point their reads *and*
+writes at it, so padding never perturbs a live sequence and never needs
+a branch inside the compiled step.
+
+The pool is the donation anchor of the decode loop: every compiled
+prefill/decode call donates the previous pool buffers and returns the
+next pool (``KVCachePool.swap``), so a long generation reuses one HBM
+allocation with zero realloc — the compiled executables never see a new
+shape and the compile cache never grows.
+
+Host-side state (free list, per-slot lengths) is plain numpy owned by
+the single scheduler thread in serving/generation.py; this class does no
+locking of its own.
+
+Capacity is budgeted *before* allocation: ``cache_bytes`` multiplies
+:func:`models.gpt.cache_bytes_per_row` by the row count, and on devices
+that report allocator stats (``observability.hbm_stats``; None on CPU)
+the constructor refuses pools that would exceed ``hbm_fraction`` of the
+device limit — slot exhaustion must surface as queue backpressure
+(``QueueFull``), never as an OOM mid-flight.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from distkeras_tpu import observability, telemetry
+from distkeras_tpu.models import gpt as gpt_lib
+
+
+class KVCachePool:
+    """Slot pool + host-side accounting for one model's decode cache.
+
+    Parameters
+    ----------
+    model: a ``CausalLM`` (or anything :func:`models.gpt.init_cache`
+        accepts).
+    num_slots: concurrent sequences the pool can hold. One extra scratch
+        row is always added for padded decode lanes.
+    device: optional ``jax.Device`` to place the pool on (default: JAX's
+        default device).
+    hbm_fraction: refuse to build a pool larger than this fraction of
+        the device's reported memory limit (no-op on hosts where
+        ``hbm_stats`` returns None, e.g. CPU).
+    """
+
+    def __init__(self, model, num_slots: int, *, device=None,
+                 dtype=None, hbm_fraction: float = 0.8):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        import jax
+
+        self.num_slots = int(num_slots)
+        self.max_len = int(model.max_len)
+        per_row = gpt_lib.cache_bytes_per_row(model, dtype)
+        self.cache_bytes = per_row * (self.num_slots + 1)
+        stats = observability.hbm_stats(device)
+        if stats and stats.get("limit_bytes"):
+            budget = hbm_fraction * stats["limit_bytes"]
+            if self.cache_bytes > budget:
+                raise ValueError(
+                    f"KV cache pool needs {self.cache_bytes} bytes "
+                    f"({self.num_slots}+1 rows x {per_row} B/row) but the "
+                    f"budget is {int(budget)} B ({hbm_fraction:.0%} of the "
+                    f"device limit {stats['limit_bytes']} B); lower "
+                    f"num_slots or max_len")
+        pool = gpt_lib.init_cache(model, self.num_slots + 1, dtype)
+        if device is not None:
+            pool = jax.device_put(pool, device)
+        #: live device pytree; replaced wholesale by swap() after every
+        #: donated prefill/decode step
+        self.pool = pool
+        #: tokens cached per slot (prompt + fed-back generations);
+        #: scheduler-thread-owned, index num_slots is the scratch row and
+        #: stays 0
+        self.lengths = np.zeros(self.num_slots + 1, np.int32)
+        self._free = list(range(self.num_slots - 1, -1, -1))
+        self._active = set()
+        telemetry.gauge("serving.decode.cache_bytes").set(self.cache_bytes)
+        self._occupancy_g = telemetry.gauge("serving.decode.slot_occupancy")
+        self._occupancy_g.set(0.0)
+
+    # -- slot lifecycle ---------------------------------------------------
+
+    @property
+    def scratch_slot(self) -> int:
+        """Row index padded decode lanes read/write (never a live slot)."""
+        return self.num_slots
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_active(self) -> int:
+        return len(self._active)
+
+    def allocate(self) -> Optional[int]:
+        """Claim a free slot (length reset to 0), or None when exhausted."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._active.add(slot)
+        self.lengths[slot] = 0
+        self._occupancy_g.set(self.num_active / self.num_slots)
+        return slot
+
+    def free(self, slot: int) -> None:
+        """Return a slot to the pool. Stale cache cells need no scrubbing:
+        every read is masked by the slot's (reset) length."""
+        if slot not in self._active:
+            raise ValueError(f"slot {slot} is not allocated")
+        self._active.remove(slot)
+        self.lengths[slot] = 0
+        self._free.append(slot)
+        self._occupancy_g.set(self.num_active / self.num_slots)
+
+    # -- device buffer handoff --------------------------------------------
+
+    def swap(self, new_pool) -> None:
+        """Install the pool returned by a donated prefill/decode call.
+        The previous buffers were consumed by the executable; holding on
+        to them would be a use-after-donate."""
+        self.pool = new_pool

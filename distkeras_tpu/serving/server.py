@@ -16,6 +16,15 @@ Protocol (header JSON + raw blobs, see remote_ps):
     {"op": "stats", "token": ...} -> {"counters": {...}, "gauges": {...}}
     {"op": "ping", "token": ...}  -> {"ok": true}
 
+    {"op": "generate", "token": ..., "length": n, "max_new_tokens": m,
+     "timeout_ms": ..., "eos_id": ...} + blob: int32 prompt tokens
+    -> zero or more {"stream": true, "tokens": [...]} frames (one per
+       emitted token chunk), then ONE typed final frame: either
+       {"done": true, "reason": "eos|length|max_len", "num_tokens": k,
+        "dtype": "int32"} + blob: the full generated sequence, or
+       {"error": "...", "kind": ...}. The final blob equals the
+       concatenated stream frames (wire-equality, asserted by test).
+
 plus the three live-health introspection ops (``status`` /
 ``metrics-snapshot`` / ``recent-spans``, see ``health/endpoints.py``) —
 the serving ``status`` digest includes the engine's queue depth and
@@ -28,6 +37,7 @@ either every row is queued or the whole request is rejected with
 
 from __future__ import annotations
 
+import queue
 import socket
 import threading
 from typing import Optional, Tuple
@@ -47,6 +57,7 @@ from distkeras_tpu.serving.batching import (
     QueueFull,
 )
 from distkeras_tpu.serving.engine import ServingEngine
+from distkeras_tpu.serving.generation import GenerationResult
 
 
 # The serving error taxonomy, declared once: clients and tests dispatch on
@@ -75,8 +86,12 @@ class ServingServer:
     """
 
     def __init__(self, engine: ServingEngine, host: str = "0.0.0.0",
-                 port: int = 0, token: Optional[str] = None):
+                 port: int = 0, token: Optional[str] = None,
+                 generator=None):
         self.engine = engine
+        #: optional GenerationEngine backing the ``generate`` op; None
+        #: keeps this a pure one-shot inference server
+        self.generator = generator
         self.token = token
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -147,6 +162,15 @@ class ServingServer:
             except Exception as e:
                 send_message(conn, {"error": str(e),
                                     "kind": _error_kind(e)})
+        elif op == "generate":
+            try:
+                self._generate(conn, header, blobs)
+            except Exception as e:
+                # synchronous rejections (QueueFull, EngineClosed, bad
+                # args) arrive before any stream frame, so the client
+                # sees exactly one typed final frame
+                send_message(conn, {"error": str(e),
+                                    "kind": _error_kind(e)})
         elif op == "stats":
             send_message(conn, self._stats())
         elif op == "ping":
@@ -154,11 +178,15 @@ class ServingServer:
         elif op in HEALTH_OPS:
             # live health plane (DESIGN.md §9): same three introspection
             # ops the parameter-server control connection mounts
-            send_message(conn, handle_health_op(op, header, extra_status={
+            extra = {
                 "service": "serving",
                 "port": self.port,
                 **self.engine.health_status(),
-            }))
+            }
+            if self.generator is not None:
+                extra["decode"] = self.generator.health_status()
+            send_message(conn, handle_health_op(op, header,
+                                                extra_status=extra))
         else:
             send_message(conn, {"error": f"unknown op {op!r}",
                                 "kind": "bad_request"})
@@ -182,6 +210,52 @@ class ServingServer:
         out = np.stack(rows) if rows else np.empty((0,), np.float32)
         send_message(conn, {"shape": list(out.shape), "dtype": str(out.dtype)},
                      [np.ascontiguousarray(out).tobytes()])
+
+    def _generate(self, conn, header: dict, blobs: list):
+        if self.generator is None:
+            raise ValueError("no generation engine mounted on this server")
+        if len(blobs) != 1:
+            raise ValueError(f"generate expects 1 blob, got {len(blobs)}")
+        prompt = np.frombuffer(blobs[0], np.int32)
+        if prompt.size != int(header["length"]):
+            raise ValueError(
+                f"prompt blob holds {prompt.size} tokens, header declares "
+                f"{header['length']}")
+        kw = {}
+        if header.get("max_new_tokens") is not None:
+            kw["max_new_tokens"] = int(header["max_new_tokens"])
+        if header.get("eos_id") is not None:
+            kw["eos_id"] = int(header["eos_id"])
+        if header.get("timeout_ms") is not None:
+            kw["timeout_ms"] = float(header["timeout_ms"])
+        q: "queue.SimpleQueue[int]" = queue.SimpleQueue()
+        fut = self.generator.generate(prompt, stream=q.put, **kw)
+        while True:
+            try:
+                chunk = [q.get(timeout=0.05)]
+            except queue.Empty:
+                # done implies every stream put already happened (the
+                # scheduler streams before completing the future), so
+                # done-then-empty means no frame can still arrive
+                if fut.done() and q.empty():
+                    break
+                continue
+            while True:
+                try:
+                    chunk.append(q.get_nowait())
+                except queue.Empty:
+                    break
+            send_message(conn, {"stream": True, "tokens": chunk})
+        exc = fut.exception()
+        if exc is not None:
+            send_message(conn, {"error": str(exc),
+                                "kind": _error_kind(exc)})
+            return
+        res = fut.result()
+        out = np.ascontiguousarray(res.tokens)
+        send_message(conn, {"done": True, "reason": res.reason,
+                            "num_tokens": int(out.size),
+                            "dtype": str(out.dtype)}, [out.tobytes()])
 
     def _stats(self) -> dict:
         reg = telemetry.get_registry()
@@ -230,6 +304,48 @@ class ServingClient:
                 f"serving ({resp.get('kind', '?')}): {resp['error']}")
         return np.frombuffer(blobs[0], np.dtype(resp["dtype"])).reshape(
             resp["shape"])
+
+    def generate(self, prompt, max_new_tokens: Optional[int] = None,
+                 timeout_ms: Optional[float] = None,
+                 eos_id: Optional[int] = None,
+                 on_token=None) -> GenerationResult:
+        """Stream one generation; returns the final
+        :class:`GenerationResult`. ``on_token`` (if given) is called with
+        each token as its stream frame arrives — before the sequence
+        finishes, which is the whole point of the streaming wire."""
+        p = np.ascontiguousarray(np.asarray(prompt, np.int32).reshape(-1))
+        header = {"op": "generate", "length": int(p.size)}
+        if max_new_tokens is not None:
+            header["max_new_tokens"] = int(max_new_tokens)
+        if timeout_ms is not None:
+            header["timeout_ms"] = float(timeout_ms)
+        if eos_id is not None:
+            header["eos_id"] = int(eos_id)
+        if self.token is not None:
+            header = dict(header, token=self.token)
+        streamed = []
+        # the lock spans the whole frame sequence: one generation owns
+        # the connection until its final frame (same serialization
+        # contract as _roundtrip)
+        with self._lock:
+            send_message(self._sock, header, [p.tobytes()])  # dktlint: disable=lock-blocking-call
+            while True:
+                resp, blobs = recv_message(self._sock)  # dktlint: disable=lock-blocking-call
+                if not resp.get("stream"):
+                    break
+                for t in resp["tokens"]:
+                    streamed.append(int(t))
+                    if on_token is not None:
+                        on_token(int(t))
+        if "error" in resp:
+            raise RuntimeError(
+                f"serving ({resp.get('kind', '?')}): {resp['error']}")
+        tokens = np.frombuffer(blobs[0], np.dtype(resp["dtype"]))
+        if streamed != tokens.tolist():
+            raise RuntimeError(
+                f"stream frames ({len(streamed)} tokens) disagree with the "
+                f"final frame ({tokens.size} tokens)")
+        return GenerationResult(tokens, resp["reason"])
 
     def stats(self) -> dict:
         resp, _ = self._roundtrip({"op": "stats"})

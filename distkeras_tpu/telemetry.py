@@ -155,12 +155,35 @@ METRIC_NAMES = {
     "serving.server.auth_failures": "counter",
     "serving.server.inflight_connections": "gauge",
     "serving.server.requests": "counter",
+    "serving.shutdown_timeouts": "counter",
     "serving.submitted": "counter",
+    # generative serving (KV-cache decode loop, DESIGN.md §14)
+    "serving.decode.admitted": "counter",
+    "serving.decode.cache_bytes": "gauge",
+    "serving.decode.compiles": "counter",
+    "serving.decode.deadline_exceeded": "counter",
+    "serving.decode.loop_errors": "counter",
+    "serving.decode.padded_lanes": "histogram",
+    "serving.decode.prefill_s": "histogram",
+    "serving.decode.prefills": "counter",
+    "serving.decode.queue_depth": "gauge",
+    "serving.decode.rejected": "counter",
+    "serving.decode.retired": "counter",
+    "serving.decode.slot_occupancy": "gauge",
+    "serving.decode.slots_active": "gauge",
+    "serving.decode.steps": "counter",
+    "serving.decode.step_s": "histogram",
+    "serving.decode.stream_errors": "counter",
+    "serving.decode.tokens": "counter",
+    "serving.decode.tokens_per_s": "gauge",
+    "serving.decode.ttft_s": "histogram",
     # trainer lifecycle
     "trainer.training_time_s": "gauge",
     # span names (the `with span("..."):` vocabulary; each also emits a
     # `span.<name>.duration_s` histogram via the prefix family below)
     "serving.compile": "span",
+    "serving.decode.compile": "span",
+    "serving.decode.warmup": "span",
     "serving.warmup": "span",
     "trainer.compile": "span",
     "trainer.epoch": "span",

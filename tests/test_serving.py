@@ -337,3 +337,63 @@ def test_dynamic_batching_beats_batch_size_one_by_4x(served):
     finally:
         batched.shutdown()
         single.shutdown()
+
+
+# ------------------------------------------------------ PR 9 satellites
+
+def test_staging_buffers_reused_per_bucket(served):
+    """_execute keeps one host staging buffer per bucket (no per-batch
+    alloc) and zeroing only the padded tail stays bitwise-correct even
+    when a big batch leaves stale rows behind for a small one."""
+    model, params = served
+    rng = np.random.default_rng(7)
+    ref = jax.jit(make_forward_fn(model))
+    with _engine(served, max_wait_ms=0.0) as eng:
+        big = rng.normal(size=(8, FEATS)).astype(np.float32)
+        np.testing.assert_array_equal(
+            np.stack([f.result(timeout=30)
+                      for f in eng.submit_many(big)]),
+            np.asarray(ref(eng.params, big)))
+        buf8 = eng._staging.get(8)
+        assert buf8 is not None
+        # now a 5-row batch lands in the same bucket: rows 5..7 are stale
+        # from the previous batch and must be re-zeroed, not resent
+        small = rng.normal(size=(5, FEATS)).astype(np.float32)
+        np.testing.assert_array_equal(
+            np.stack([f.result(timeout=30)
+                      for f in eng.submit_many(small)]),
+            np.asarray(ref(eng.params, small)))
+        assert eng._staging.get(8) is buf8  # same buffer, reused
+        assert np.all(buf8[5:] == 0)        # padded tail was zeroed
+        assert set(eng._staging) <= set(eng.spec.sizes)
+
+
+def test_queue_gauges_live_without_health_poll(served):
+    """The batcher loop refreshes queue_depth/oldest_request_age_s after
+    every pop — a metrics snapshot between submits is current even if
+    health_status() is never called."""
+    with _engine(served, max_wait_ms=0.0) as eng:
+        eng.submit(np.zeros(FEATS, np.float32)).result(timeout=30)
+        assert telemetry.gauge("serving.queue_depth").value == 0
+        assert telemetry.gauge("serving.oldest_request_age_s").value == 0.0
+
+
+def test_shutdown_timeout_fails_pending_and_counts(served):
+    """A join that times out must not silently strand submitters: the
+    timeout is counted and still-queued futures fail with EngineClosed."""
+    eng = _engine(served, warmup=False)
+    # retire the real batcher cleanly, then wedge the engine: a sleeper
+    # thread stands in for a batcher stuck on a bad batch
+    eng._queue.close()
+    eng._thread.join(timeout=30)
+    assert not eng._thread.is_alive()
+    stuck = Request(np.zeros(FEATS, np.float32), time.monotonic(), None)
+    with eng._queue._cv:
+        eng._queue._dq.append(stuck)  # bypasses the closed-queue gate
+    eng._thread = threading.Thread(target=time.sleep, args=(30.0,),
+                                   daemon=True)
+    eng._thread.start()
+    eng.shutdown(drain=True, timeout=0.05)
+    assert telemetry.counter("serving.shutdown_timeouts").value == 1
+    with pytest.raises(EngineClosed):
+        stuck.future.result(timeout=1)

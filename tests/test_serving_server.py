@@ -136,3 +136,129 @@ def test_concurrent_tcp_clients_get_their_own_rows(served):
     finally:
         srv.stop()
         eng.shutdown()
+
+
+# ------------------------------------------------- generative streaming wire
+
+@pytest.fixture(scope="module")
+def lm():
+    from distkeras_tpu.models.gpt import gpt_tiny
+
+    model = gpt_tiny()
+    params = model.init(jax.random.key(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _stack_with_generator(served, token=None, generator=None, **engine_kw):
+    model, params = served
+    engine_kw.setdefault("buckets", (1, 8))
+    eng = ServingEngine(model, params, input_shape=(FEATS,), **engine_kw)
+    srv = ServingServer(eng, host="127.0.0.1", token=token,
+                        generator=generator)
+    srv.start()
+    return eng, srv
+
+
+def test_generate_streams_and_matches_local_engine(served, lm):
+    """Wire equality: the streamed frames, the final frame, and a local
+    GenerationEngine run of the same prompt all agree; stream tokens
+    arrive strictly before the final result lands."""
+    from distkeras_tpu.serving import GenerationEngine
+
+    model, params = lm
+    gen = GenerationEngine(model, params, num_slots=2,
+                           prefill_buckets=(8,))
+    eng, srv = _stack_with_generator(served, generator=gen)
+    try:
+        cli = ServingClient(f"127.0.0.1:{srv.port}")
+        prompt = np.arange(1, 7, dtype=np.int32)
+        streamed = []
+        res = cli.generate(prompt, max_new_tokens=9,
+                           on_token=streamed.append)
+        assert res.reason == "length"
+        assert streamed == res.tokens.tolist()
+        local = gen.generate(prompt, max_new_tokens=9).result(timeout=60)
+        assert res.tokens.tolist() == local.tokens.tolist()
+        cli.close()
+    finally:
+        srv.stop()
+        eng.shutdown()
+        gen.shutdown()
+
+
+def test_generate_requires_auth(served, lm):
+    from distkeras_tpu.serving import GenerationEngine
+
+    model, params = lm
+    gen = GenerationEngine(model, params, num_slots=1,
+                           prefill_buckets=(8,))
+    eng, srv = _stack_with_generator(served, token="s3cret", generator=gen)
+    try:
+        good = ServingClient(f"127.0.0.1:{srv.port}", token="s3cret")
+        assert good.generate(np.arange(1, 5, dtype=np.int32),
+                             max_new_tokens=2).tokens.size == 2
+        good.close()
+        bad = ServingClient(f"127.0.0.1:{srv.port}", token="wrong")
+        with pytest.raises(RuntimeError, match="auth"):
+            bad.generate(np.arange(1, 5, dtype=np.int32), max_new_tokens=2)
+        bad.close()
+    finally:
+        srv.stop()
+        eng.shutdown()
+        gen.shutdown()
+
+
+def test_generate_typed_errors(served, lm):
+    from distkeras_tpu.serving import GenerationEngine
+
+    model, params = lm
+    # no generator mounted -> bad_request, connection stays usable
+    eng, srv = _stack_with_generator(served, generator=None)
+    try:
+        cli = ServingClient(f"127.0.0.1:{srv.port}")
+        with pytest.raises(RuntimeError, match="bad_request"):
+            cli.generate(np.arange(1, 5, dtype=np.int32))
+        assert cli.ping()
+        cli.close()
+    finally:
+        srv.stop()
+        eng.shutdown()
+
+    gen = GenerationEngine(model, params, num_slots=1,
+                           prefill_buckets=(8,))
+    eng, srv = _stack_with_generator(served, generator=gen)
+    try:
+        cli = ServingClient(f"127.0.0.1:{srv.port}")
+        # undeclared prompt shape -> bad_request (engine validation)
+        with pytest.raises(RuntimeError, match="bad_request"):
+            cli.generate(np.arange(1, 30, dtype=np.int32))
+        # closed generator -> closed
+        gen.shutdown()
+        with pytest.raises(RuntimeError, match="closed"):
+            cli.generate(np.arange(1, 5, dtype=np.int32), max_new_tokens=2)
+        assert cli.ping()  # the connection survived every typed error
+        cli.close()
+    finally:
+        srv.stop()
+        eng.shutdown()
+
+
+def test_status_merges_decode_state(served, lm):
+    from distkeras_tpu.serving import GenerationEngine
+
+    model, params = lm
+    gen = GenerationEngine(model, params, num_slots=2, slot_ladder=(1, 2),
+                           prefill_buckets=(8,))
+    eng, srv = _stack_with_generator(served, generator=gen)
+    try:
+        cli = ServingClient(f"127.0.0.1:{srv.port}")
+        resp, _ = cli._roundtrip({"op": "status"})
+        assert resp["decode"]["num_slots"] == 2
+        assert resp["decode"]["compiled"] == {"prefill": [8],
+                                              "decode": [1, 2]}
+        cli.close()
+    finally:
+        srv.stop()
+        eng.shutdown()
+        gen.shutdown()
