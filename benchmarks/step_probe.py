@@ -171,6 +171,98 @@ def probe(name: str, batch: int, steps: int = 8) -> dict:
     return out
 
 
+def phase_probe(name: str, batch: int, steps: int = 8,
+                iters: int = 3) -> dict:
+    """Step-time decomposition of the bare-step window (DESIGN.md §15).
+
+    Times each window's phases separately — ``h2d`` (host batch onto the
+    device, fetch-synced), ``compute`` (the jitted scan, fetch-synced),
+    and on multi-device hosts ``collective`` (a grad-sized psum across
+    all local devices — the sync the DP path would pay at this model's
+    gradient size) — publishing every sample into the
+    ``profile.phase.*_s`` histograms (the same names host_async's worker
+    loop feeds) and returning one JSON row with per-phase seconds and
+    fractions of the window. benchmarks/attribution.py renders either
+    source into the same gap-to-peak report.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distkeras_tpu import engine, observability, telemetry
+
+    if telemetry.get_registry() is None:
+        telemetry.install(telemetry.MetricsRegistry())
+    model, loss, x, y = build_family(name, batch)
+    tx = optax.adamw(1e-3)
+    grad_fn = engine.make_grad_fn(model, loss)
+    xd, yd = jnp.asarray(x), jnp.asarray(y)
+    state = engine.create_train_state(model, jax.random.key(0),
+                                      {"features": xd}, tx)
+
+    @jax.jit
+    def run(params, opt_state, x, y):
+        def one(c, _):
+            p, o = c
+            (l, _), g = grad_fn(p, {"features": x, "labels": y}, None)
+            up, o = tx.update(g, o, p)
+            return (optax.apply_updates(p, up), o), l
+
+        (p, o), ls = jax.lax.scan(one, (params, opt_state), None,
+                                  length=steps)
+        return p, o, jnp.sum(ls)
+
+    devices = jax.devices()
+    psum = None
+    if len(devices) > 1:
+        psum = jax.pmap(lambda t: jax.tree.map(
+            lambda a: jax.lax.psum(a, "d"), t), axis_name="d")
+        rep = jax.device_put_replicated(state.params, devices)
+        jax.block_until_ready(psum(rep))  # compile outside the window
+    flops = observability.count_flops(
+        lambda p, b: grad_fn(p, b, None)[1], state.params,
+        {"features": xd, "labels": yd}) * steps
+    p, o, s = run(state.params, state.opt_state, xd, yd)
+    float(np.asarray(s))  # compile + settle
+    prof = {ph: telemetry.histogram(f"profile.phase.{ph}_s")
+            for ph in ("h2d", "compute", "collective", "window")}
+    phases = {ph: [] for ph in prof}
+    for _ in range(iters):
+        t_start = time.perf_counter()
+        xi = jax.block_until_ready(jnp.asarray(x))
+        yi = jax.block_until_ready(jnp.asarray(y))
+        t1 = time.perf_counter()
+        p, o, s = run(p, o, xi, yi)
+        float(np.asarray(s))
+        t2 = time.perf_counter()
+        if psum is not None:
+            rep = jax.block_until_ready(psum(rep))
+            t3 = time.perf_counter()
+            phases["collective"].append(t3 - t2)
+            prof["collective"].record(t3 - t2)
+        phases["h2d"].append(t1 - t_start)
+        prof["h2d"].record(t1 - t_start)
+        phases["compute"].append(t2 - t1)
+        prof["compute"].record(t2 - t1)
+        win = time.perf_counter() - t_start
+        phases["window"].append(win)
+        prof["window"].record(win)
+    med = lambda v: sorted(v)[len(v) // 2] if v else None
+    window = med(phases["window"])
+    out = {"model": name, "batch": batch, "steps_per_call": steps,
+           "window_s": round(window, 6),
+           "samples_per_sec": round(batch * steps / window, 1)}
+    for ph in ("h2d", "compute", "collective"):
+        m = med(phases[ph])
+        if m is not None:
+            out[f"phase_{ph}_s"] = round(m, 6)
+            out[f"phase_{ph}_frac"] = round(m / window, 4)
+    peak = observability.device_peak_flops()
+    if peak:
+        out["mfu"] = round(flops / med(phases["compute"]) / peak, 4)
+    return out
+
+
 #: canonical per-family settings — the shapes each family's BASELINE.md
 #: floor is defined at (resnet's MXU sweet spot is b128; gpt OOMs above
 #: b8 at seq 2048). CLI --batch/--steps override.
@@ -381,6 +473,10 @@ def main():
     ap.add_argument("--find-max-batch", action="store_true",
                     help="sweep mode: also run the doubling largest-batch "
                          "search per config (accelerator-backed runs)")
+    ap.add_argument("--phases", action="store_true",
+                    help="probe mode: decompose each window into "
+                         "profile.phase.* (h2d / compute / collective) "
+                         "instead of the single timed call")
     args = ap.parse_args()
     parse_axis = lambda s: [None if v.strip() in ("none", "") else v.strip()
                             for v in s.split(",")]
@@ -438,7 +534,8 @@ def main():
         if args.steps is not None:
             cfg["steps"] = args.steps
         try:
-            print(json.dumps(probe(name, cfg["batch"], steps=cfg["steps"])))
+            fn = phase_probe if args.phases else probe
+            print(json.dumps(fn(name, cfg["batch"], steps=cfg["steps"])))
         except Exception as e:
             print(json.dumps({"model": name,
                               "error": f"{type(e).__name__}: {e}"}))

@@ -11,11 +11,19 @@ round cites.
 Usage:
   python benchmarks/telemetry_summary.py <run.telemetry.jsonl> [--top N]
   python benchmarks/telemetry_summary.py <run.telemetry.jsonl> --format prom
+  python benchmarks/telemetry_summary.py <p0.jsonl> <p1.jsonl> ... --merge
 
 ``--format prom`` renders the artifact in the Prometheus text exposition
 format instead of the human tables (same exporter as the live
 ``health.cli metrics --format prom`` path), so a post-run artifact can be
 pushed through a Pushgateway or diffed against a live scrape.
+
+``--merge`` is the cross-process tracing view (DESIGN.md §15): give it
+one artifact per process (or a single collector-merged artifact whose
+rows already carry ``pid``) and it groups the traced spans by
+``trace_id``, printing each trace's spans in start order with their
+process, parent linkage, and duration — the textual twin of the merged
+Chrome trace.
 
 No third-party deps: the artifact is plain JSON lines (schema in
 distkeras_tpu/telemetry.py and DESIGN.md §5b).
@@ -121,25 +129,82 @@ def summarize(rows: list, top: int = 20) -> str:
     return "\n".join(out)
 
 
+def merge_view(rows: list, top: int = 20) -> str:
+    """Group traced spans by trace_id across processes (the ``--merge``
+    report). Spans print in start order; ``ts`` offsets are relative to
+    the trace's first span WITHIN each process (perf_counter origins are
+    per-process, so cross-process offsets are not comparable — the pid
+    column is the honest boundary)."""
+    traces = collections.defaultdict(list)
+    for r in rows:
+        if r.get("kind") == "span" and "trace_id" in r:
+            traces[r["trace_id"]].append(r)
+    out = [f"# merged trace view: {len(traces)} traces, "
+           f"{sum(len(v) for v in traces.values())} traced spans, "
+           f"{len({r.get('pid', 0) for v in traces.values() for r in v})} "
+           f"processes"]
+    # longest traces first: those are the windows that crossed the wire
+    ranked = sorted(traces.items(), key=lambda kv: (-len(kv[1]), kv[0]))
+    for trace_id, spans in ranked[:top]:
+        spans = sorted(spans, key=lambda r: (r.get("pid", 0), r["t0"]))
+        pids = sorted({r.get("pid", 0) for r in spans})
+        out.append(f"\n## trace {trace_id}  ({len(spans)} spans, "
+                   f"processes {pids})")
+        t0_by_pid = {}
+        for r in spans:
+            t0_by_pid.setdefault(r.get("pid", 0), r["t0"])
+        width = max(len(_full_name(r)) for r in spans)
+        out.append(f"{'pid':>3s} {'+ms':>10s} {'dur_ms':>10s} "
+                   f"{'name':{width}s}  parent")
+        for r in spans:
+            pid = r.get("pid", 0)
+            rel = (r["t0"] - t0_by_pid[pid]) * 1e3
+            out.append(
+                f"{pid:3d} {rel:10.3f} {r['dur_s'] * 1e3:10.3f} "
+                f"{_full_name(r):{width}s}  "
+                f"{r.get('parent_id', '-')} -> {r.get('span_id', '-')}")
+    if len(ranked) > top:
+        out.append(f"\n({len(ranked) - top} more traces not shown; "
+                   f"raise --top)")
+    return "\n".join(out)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="summarize a distkeras_tpu telemetry JSONL artifact")
-    ap.add_argument("path", help="telemetry .jsonl written by "
-                    "Trainer(telemetry_path=...) / dump_telemetry()")
+    ap.add_argument("paths", nargs="+", metavar="path",
+                    help="telemetry .jsonl written by "
+                    "Trainer(telemetry_path=...) / dump_telemetry(); "
+                    "--merge accepts one per process")
     ap.add_argument("--top", type=int, default=20,
-                    help="span rows to show (default 20)")
+                    help="span rows (or --merge traces) to show "
+                         "(default 20)")
     ap.add_argument("--format", choices=("text", "prom"), default="text",
                     help="'text' = human tables (default); 'prom' = "
                          "Prometheus text exposition (health/export.py)")
+    ap.add_argument("--merge", action="store_true",
+                    help="cross-process trace view: group spans by "
+                         "trace_id (rows from the i-th artifact default "
+                         "to pid=i when untagged)")
     args = ap.parse_args(argv)
-    try:
-        rows = load_rows(args.path)
-    except OSError as e:
-        sys.exit(f"cannot read {args.path}: {e}")
+    if len(args.paths) > 1 and not args.merge:
+        sys.exit("multiple artifacts only make sense with --merge")
+    rows = []
+    for i, path in enumerate(args.paths):
+        try:
+            file_rows = load_rows(path)
+        except OSError as e:
+            sys.exit(f"cannot read {path}: {e}")
+        for r in file_rows:
+            if "pid" not in r and len(args.paths) > 1:
+                r = dict(r, pid=i)
+            rows.append(r)
     if not rows:
-        sys.exit(f"{args.path}: empty artifact")
+        sys.exit(f"{args.paths[0]}: empty artifact")
     try:
-        if args.format == "prom":
+        if args.merge:
+            print(merge_view(rows, top=args.top))
+        elif args.format == "prom":
             from distkeras_tpu.health.export import rows_to_prometheus
 
             sys.stdout.write(rows_to_prometheus(rows))

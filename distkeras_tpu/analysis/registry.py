@@ -46,6 +46,7 @@ _METRIC_SHAPE = re.compile(r"[a-z][a-z0-9_]*(\.[a-z0-9_]+)+")
 _CONSUMER_PATHS = (
     "benchmarks/telemetry_summary.py",
     "benchmarks/health_probe.py",
+    "benchmarks/attribution.py",
     "distkeras_tpu/health/export.py",
     "distkeras_tpu/health/endpoints.py",
 )

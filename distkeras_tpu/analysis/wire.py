@@ -66,11 +66,16 @@ PROTOCOLS: Tuple[Protocol, ...] = (
         server_paths=("distkeras_tpu/serving/server.py",),
         client_paths=("distkeras_tpu/serving/server.py",
                       "distkeras_tpu/health/endpoints.py"),
+        # HealthClient is shared across every server; the fleet-telemetry
+        # merge op is mounted only on the PS coordinator (remote_ps), and
+        # the CLI catches the clean "unknown op" error and falls back
+        client_only=("telemetry_merged",),
     ),
     Protocol(
         name="health",
         server_paths=("distkeras_tpu/health/endpoints.py",),
         client_paths=("distkeras_tpu/health/endpoints.py",),
+        client_only=("telemetry_merged",),
     ),
 )
 

@@ -11,6 +11,11 @@ Commands: ``status`` (one liveness digest), ``metrics`` (full snapshot as
 JSON or Prometheus text), ``spans`` (recent span events; ``--chrome PATH``
 writes a chrome://tracing file instead), ``watch`` (poll ``status``
 forever — or ``--count N`` times — printing one compact line per poll).
+``watch --table`` renders one row PER WORKER per poll instead (heartbeat
+age, windows completed, window rate over the poll interval, staleness,
+degraded-window count, straggler flag), preferring the coordinator's
+fleet-merged collector view (``telemetry_merged``) and falling back to
+the peer's local snapshot when the service doesn't carry a collector.
 Pass ``--token`` when the service was started with a shared secret.
 """
 
@@ -23,7 +28,50 @@ import time
 from typing import Optional
 
 from distkeras_tpu.health import export
+from distkeras_tpu.health.collector import worker_table
 from distkeras_tpu.health.endpoints import HealthClient
+
+
+def _snapshot_rows(snapshot: dict) -> list:
+    """Flatten a ``metrics-snapshot`` payload into row dicts so the
+    fallback path feeds :func:`worker_table` the same shape the merged
+    collector stream does."""
+    rows = []
+    for kind in ("gauge", "counter"):
+        for key, value in snapshot.get(kind + "s", {}).items():
+            name, labels = export._parse_key(key)
+            rows.append({"kind": kind, "name": name, "labels": labels,
+                         "value": value})
+    return rows
+
+
+def _fleet_rows(client: HealthClient) -> list:
+    try:
+        return client.merged_rows()
+    except RuntimeError:  # no collector behind this address
+        return _snapshot_rows(client.metrics_snapshot())
+
+
+def _watch_table(workers: dict, prev: dict, interval: float) -> str:
+    cols = ("worker", "hb_age", "windows", "win/s", "staleness",
+            "degraded", "flag")
+    lines = [time.strftime("%H:%M:%S") + "  " +
+             " ".join(f"{c:>9s}" for c in cols)]
+    for worker in sorted(workers, key=str):
+        w = workers[worker]
+        windows = w.get("windows", 0)
+        rate = "-"
+        if worker in prev and interval > 0:
+            rate = f"{max(0, windows - prev[worker]) / interval:.2f}"
+        age = w.get("age_s")
+        vals = (worker, "-" if age is None else f"{age:.1f}s",
+                str(windows), rate, str(w.get("staleness", "-")),
+                str(w.get("degraded", 0)),
+                "STRAGGLER" if w.get("straggler") else "ok")
+        lines.append("          " + " ".join(f"{v:>9s}" for v in vals))
+    if len(lines) == 1:
+        lines.append("          (no workers reporting yet)")
+    return "\n".join(lines)
 
 
 def _watch_line(status: dict) -> str:
@@ -64,6 +112,10 @@ def main(argv: Optional[list] = None) -> int:
                     help="seconds between polls (watch command)")
     ap.add_argument("--count", type=int, default=0,
                     help="stop watch after N polls (0 = forever)")
+    ap.add_argument("--table", action="store_true",
+                    help="watch: one row per worker (heartbeat age, "
+                         "window rate, staleness, degraded count) from "
+                         "the fleet-merged collector view when available")
     args = ap.parse_args(argv)
 
     with HealthClient(args.address, token=args.token) as client:
@@ -84,8 +136,17 @@ def main(argv: Optional[list] = None) -> int:
                 print(json.dumps(spans, indent=2))
         else:  # watch
             n = 0
+            prev_windows: dict = {}
             while True:
-                print(_watch_line(client.status()), flush=True)
+                if args.table:
+                    workers = worker_table(_fleet_rows(client), time.time())
+                    print(_watch_table(workers, prev_windows,
+                                       args.interval if n else 0.0),
+                          flush=True)
+                    prev_windows = {w: d.get("windows", 0)
+                                    for w, d in workers.items()}
+                else:
+                    print(_watch_line(client.status()), flush=True)
                 n += 1
                 if args.count and n >= args.count:
                     break

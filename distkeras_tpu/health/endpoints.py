@@ -164,6 +164,13 @@ class HealthClient:
     def recent_spans(self, limit: int = 100) -> List[dict]:
         return self._call("recent-spans", limit=int(limit))["spans"]
 
+    def merged_rows(self) -> List[dict]:
+        """The fleet-merged telemetry rows from the peer's collector
+        (parameter-server coordinator only). Raises RuntimeError against
+        a service without the op; the CLI falls back to the local
+        snapshot."""
+        return self._call("telemetry_merged")["rows"]
+
     def close(self) -> None:
         try:
             self._sock.close()

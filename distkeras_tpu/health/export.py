@@ -134,10 +134,16 @@ def snapshot_to_prometheus(snapshot: dict) -> str:
     return rows_to_prometheus(snapshot_to_rows(snapshot))
 
 
-def chrome_trace(rows: Iterable[dict]) -> dict:
+def chrome_trace(rows: Iterable[dict], pid: int = 0) -> dict:
     """Span rows → a Chrome/Perfetto trace object (counters/gauges are
     skipped — they belong in the Prometheus view). ``ts`` keeps the
-    registry's monotonic origin; within one process events line up."""
+    registry's monotonic origin; within one process events line up.
+
+    Merged multi-process streams (collector.merged_rows) carry a ``pid``
+    per row, which becomes the Chrome process lane; rows without one fall
+    back to the ``pid`` argument. Traced spans carry their
+    trace_id/span_id/parent_id into ``args`` so a Perfetto query (or the
+    tests) can follow one trace_id across process lanes."""
     tids: Dict[tuple, int] = {}
     events: List[dict] = []
     for row in rows:
@@ -147,12 +153,16 @@ def chrome_trace(rows: Iterable[dict]) -> dict:
         series = (row["name"], tuple(sorted(
             (str(k), str(v)) for k, v in labels.items())))
         tid = tids.setdefault(series, len(tids))
+        args = {str(k): v for k, v in labels.items()}
+        for key in ("trace_id", "span_id", "parent_id"):
+            if key in row:
+                args[key] = row[key]
         events.append({
             "name": row["name"], "ph": "X", "cat": "telemetry",
             "ts": float(row["t0"]) * 1e6,
             "dur": float(row["dur_s"]) * 1e6,
-            "pid": 0, "tid": tid,
-            "args": {str(k): v for k, v in labels.items()},
+            "pid": int(row.get("pid", pid)), "tid": tid,
+            "args": args,
         })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 

@@ -39,6 +39,7 @@ from typing import Any, Callable, Optional, Sequence
 import jax
 import numpy as np
 
+from distkeras_tpu import telemetry
 from distkeras_tpu.health.heartbeat import StragglerDetector
 from distkeras_tpu.health.membership import DEFAULT_LEASE_S, Membership
 from distkeras_tpu.parallel.remote_ps import (
@@ -181,18 +182,32 @@ class ShardedRemoteParameterServer:
         parts = split_tree(delta, self.assignment)
         if seq is None:
             seq = self.clients[0].next_seq()
+        # the fan-out is the trace's branching point: the caller's commit
+        # span is the parent, each shard leg a child. Pool threads do not
+        # inherit thread-local context, so follower legs adopt it
+        # explicitly (None when the commit is untraced — plain path).
+        ctx = telemetry.current_trace()
         # coordinator first: its fold fixes the authoritative weight (and
         # runs the membership plane — late folds, lease renewal); every
         # follower then folds the same commit at that explicit weight
-        at_fold, applied = self.clients[0].commit_ex(
-            parts[0], last_update=last_update, weight=weight, seq=seq,
-            worker=worker, window_s=window_s)
+        with telemetry.span("trace.shard", shard=0):
+            at_fold, applied = self.clients[0].commit_ex(
+                parts[0], last_update=last_update, weight=weight, seq=seq,
+                worker=worker, window_s=window_s)
         futures = [
-            self._pool.submit(c.commit_ex, part, last_update, applied, seq)
-            for c, part in zip(self.clients[1:], parts[1:])]
+            self._pool.submit(self._shard_leg, ctx, i, c, part,
+                              last_update, applied, seq)
+            for i, (c, part) in enumerate(
+                zip(self.clients[1:], parts[1:]), start=1)]
         for f in futures:
             f.result()
         return at_fold, applied
+
+    @staticmethod
+    def _shard_leg(ctx, shard, client, part, last_update, applied, seq):
+        with telemetry.use_trace(ctx):
+            with telemetry.span("trace.shard", shard=shard):
+                return client.commit_ex(part, last_update, applied, seq)
 
     @property
     def num_updates(self) -> int:
@@ -214,6 +229,13 @@ class ShardedRemoteParameterServer:
 
     def put_history(self, pid: int, windows: list) -> None:
         self.clients[0].put_history(pid, windows)
+
+    # the telemetry collector also lives on the coordinator shard
+    def put_telemetry(self, pid: int, rows: list) -> dict:
+        return self.clients[0].put_telemetry(pid, rows)
+
+    def get_merged_telemetry(self) -> list:
+        return self.clients[0].get_merged_telemetry()
 
     def get_history(self, timeout: float = 600):
         # the barrier (and merged history, and final clock) live on the

@@ -24,6 +24,7 @@ of pickled TCP.
 
 from __future__ import annotations
 
+import contextlib
 import queue as queue_lib
 import threading
 import time
@@ -51,6 +52,21 @@ from distkeras_tpu.parallel.strategies import Strategy
 def _tree_add(a, b):
     """Leafwise sum — the degradation ladder's backlog accumulator."""
     return jax.tree.map(lambda x, y: x + y, a, b)
+
+
+@contextlib.contextmanager
+def _window_trace(enabled: bool, wid: int, fold: int):
+    """Root one trace per worker window (DESIGN.md §15): the trace.window
+    span parents the pull/compute/commit spans below it, and the commit's
+    traceparent rides the wire so transport retries and shard folds in
+    OTHER processes chain under this same trace_id."""
+    if not enabled or telemetry.get_registry() is None:
+        yield None
+        return
+    ctx = telemetry.TraceContext.new_root(worker=str(wid), window=str(fold))
+    with telemetry.use_trace(ctx):
+        with telemetry.span("trace.window", worker=wid) as child:
+            yield child
 
 
 def server_for(strategy: Strategy, params) -> ParameterServer:
@@ -166,9 +182,18 @@ class HostAsyncRunner:
                  devices: Optional[Sequence[jax.Device]] = None,
                  codec: Optional[str] = None, overlap: bool = False,
                  accum_steps: int = 1, precision: Optional[str] = None,
-                 max_degraded_windows: int = 16):
+                 max_degraded_windows: int = 16, trace: bool = True):
         self.strategy = strategy
         self.window = int(window)
+        # distributed tracing (DESIGN.md §15): each worker window becomes
+        # one trace whose spans follow the commit through the transport
+        # (retries, reconnects) and across shard folds. trace=False keeps
+        # the plain (context-free) span events — the tracing-off baseline
+        # benchmarks/attribution.py measures overhead against.
+        self.trace = bool(trace)
+        # merged multi-process rows from the last run_cross_process (set
+        # on process 0 when the coordinator mounts a collector)
+        self.fleet_telemetry: Optional[list] = None
         # degradation ladder budget (DESIGN.md §13): how many consecutive
         # compute-only windows a worker rides out against an unreachable
         # fleet (stale center, commits accumulated locally) before the
@@ -448,54 +473,85 @@ class HostAsyncRunner:
         backlog_clock = 0   # pull clock of the OLDEST unfolded window
         deferred: list = []  # (pull_clock, ms, win_s) awaiting a fold clock
         last_center = None  # last successfully pulled (center, clock)
-        for batches in prefetch(rounds, depth=1):
+        # step-time decomposition (DESIGN.md §15): the top-level phases
+        # data_wait/pull/h2d/compute/commit/bookkeep PARTITION each window
+        # (attribution.py asserts they sum to >=95% of window wall-time);
+        # encode/decode/fold land as nested sub-phases from the codec/PS
+        prof = {name: telemetry.histogram(f"profile.phase.{name}_s",
+                                          worker=wid)
+                for name in ("data_wait", "pull", "h2d", "compute",
+                             "commit", "bookkeep", "window")}
+        it = iter(prefetch(rounds, depth=1))
+        while True:
+            t_start = time.perf_counter()
+            try:
+                batches = next(it)
+            except StopIteration:
+                break
             if abort.is_set():
                 return  # a sibling died: stop wasting windows
-            t0 = time.perf_counter()
-            try:
-                center, clock = ps.pull()
-                last_center = (center, clock)
-            except PSUnavailable:
-                if last_center is None:
-                    raise  # never reached the fleet at all: a real error
-                center, clock = last_center  # compute-only: stale center
-            t1 = time.perf_counter()
-            pull_h.record(t1 - t0)
-            carry, commit, ms = self.window_fn(
-                carry, jax.device_put(center, dev), batches,
-                np.int32(wid * 1_000_003 + fold))
-            jax.block_until_ready(commit)
-            t2 = time.perf_counter()
-            win_s = t2 - t1
-            win_h.record(win_s)
-            to_send, last_up = commit, clock
-            if backlog is not None:
-                to_send = _tree_add(backlog, commit)
-                last_up = backlog_clock
-            try:
-                if elastic:
-                    clock_at_fold = ps.commit(to_send, last_update=last_up,
-                                              worker=wid, window_s=win_s)
-                else:
-                    clock_at_fold = ps.commit(to_send, last_update=last_up)
-            except PSUnavailable:
-                degraded += 1
-                telemetry.counter("host_async.degraded_windows",
-                                  worker=wid).inc()
-                if degraded > self.max_degraded_windows:
-                    raise
-                backlog, backlog_clock = to_send, last_up
-                deferred.append((clock, ms, win_s))
-                fold += 1
-                continue
-            commit_h.record(time.perf_counter() - t2)
-            degraded = 0
-            backlog = None
-            for d_clock, d_ms, d_win_s in deferred:
-                bookkeep(clock_at_fold, d_clock, d_ms, d_win_s)
-            deferred.clear()
-            bookkeep(clock_at_fold, clock, ms, win_s)
+            prof["data_wait"].record(time.perf_counter() - t_start)
+            with _window_trace(self.trace, wid, fold):
+                t0 = time.perf_counter()
+                try:
+                    with telemetry.span("trace.pull", worker=wid):
+                        center, clock = ps.pull()
+                    last_center = (center, clock)
+                except PSUnavailable:
+                    if last_center is None:
+                        raise  # never reached the fleet at all: real error
+                    center, clock = last_center  # compute-only: stale
+                t1 = time.perf_counter()
+                pull_h.record(t1 - t0)
+                prof["pull"].record(t1 - t0)
+                center_dev = jax.device_put(center, dev)
+                t_h2d = time.perf_counter()
+                prof["h2d"].record(t_h2d - t1)
+                with telemetry.span("trace.compute", worker=wid):
+                    carry, commit, ms = self.window_fn(
+                        carry, center_dev, batches,
+                        np.int32(wid * 1_000_003 + fold))
+                    jax.block_until_ready(commit)
+                t2 = time.perf_counter()
+                win_s = t2 - t1  # h2d + compute, as before the split
+                win_h.record(win_s)
+                prof["compute"].record(t2 - t_h2d)
+                to_send, last_up = commit, clock
+                if backlog is not None:
+                    to_send = _tree_add(backlog, commit)
+                    last_up = backlog_clock
+                landed = True
+                try:
+                    with telemetry.span("trace.commit", worker=wid):
+                        if elastic:
+                            clock_at_fold = ps.commit(
+                                to_send, last_update=last_up,
+                                worker=wid, window_s=win_s)
+                        else:
+                            clock_at_fold = ps.commit(to_send,
+                                                      last_update=last_up)
+                except PSUnavailable:
+                    degraded += 1
+                    telemetry.counter("host_async.degraded_windows",
+                                      worker=wid).inc()
+                    if degraded > self.max_degraded_windows:
+                        raise
+                    backlog, backlog_clock = to_send, last_up
+                    deferred.append((clock, ms, win_s))
+                    landed = False
+                if landed:
+                    t3 = time.perf_counter()
+                    commit_h.record(t3 - t2)
+                    prof["commit"].record(t3 - t2)
+                    degraded = 0
+                    backlog = None
+                    for d_clock, d_ms, d_win_s in deferred:
+                        bookkeep(clock_at_fold, d_clock, d_ms, d_win_s)
+                    deferred.clear()
+                    bookkeep(clock_at_fold, clock, ms, win_s)
+                    prof["bookkeep"].record(time.perf_counter() - t3)
             fold += 1
+            prof["window"].record(time.perf_counter() - t_start)
         if backlog is not None:
             # the run ended degraded: one last flush so the backlogged
             # windows are not silently dropped from the center/history
@@ -550,10 +606,18 @@ class HostAsyncRunner:
                         else:
                             clock_at_fold = ps.commit(commit,
                                                       last_update=pull_clock)
-                        commit_h.record(time.perf_counter() - t0)
+                        dt = time.perf_counter() - t0
+                        commit_h.record(dt)
+                        # overlapped comms still feed the phase profile;
+                        # attribution reads them as hidden-behind-compute
+                        telemetry.histogram("profile.phase.commit_s",
+                                            worker=wid).record(dt)
                     t0 = time.perf_counter()
                     center, clock = ps.pull()
-                    pull_h.record(time.perf_counter() - t0)
+                    dt = time.perf_counter() - t0
+                    pull_h.record(dt)
+                    telemetry.histogram("profile.phase.pull_s",
+                                        worker=wid).record(dt)
                     resp.put((center, clock, clock_at_fold))
             except Exception as e:
                 resp.put(e)
@@ -632,6 +696,7 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
     """
     from jax.experimental import multihost_utils
 
+    from distkeras_tpu.health.collector import TelemetryCollector
     from distkeras_tpu.parallel import elastic as elastic_mod
     from distkeras_tpu.parallel import remote_ps as rps
 
@@ -664,7 +729,8 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
                     service = rps.ParameterServerService(
                         ps, init_params,
                         expected_processes=jax.process_count(),
-                        port=service_port, token=token)
+                        port=service_port, token=token,
+                        collector=TelemetryCollector())
                     service.start()
                     ports: Any = service.port
                 else:
@@ -682,6 +748,9 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
                         expected_processes=jax.process_count(),
                         token=token, straggler=StragglerDetector(),
                         advertise_host=advertise)
+                    # the fleet telemetry sink lives on the coordinator
+                    # shard, next to membership and history
+                    services[0].collector = TelemetryCollector()
                     ports = [svc.port for svc in services]
             except Exception:
                 rps.share_service_address(None, error=True)
@@ -735,9 +804,21 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
             client.put_history(pid, runner.merged_windows)
             merged, center, clock = client.get_history(
                 timeout=history_timeout)
+        # fleet telemetry aggregation: every remote process pushes its
+        # registry rows to the coordinator's collector (best-effort) after
+        # the history barrier, so the push rides an idle, settled fleet
+        reg = telemetry.get_registry()
+        if pid != 0 and reg is not None and client is not None:
+            client.put_telemetry(pid, list(reg.rows()))
         # everyone holds the final state before process 0 tears the
-        # service down (a late reader must not hit a dead socket)
+        # service down (a late reader must not hit a dead socket); the
+        # barrier also orders the pushes above before the merge below
         multihost_utils.sync_global_devices("distkeras_host_async_done")
+        if pid == 0:
+            collector = (service.collector if service is not None
+                         else services[0].collector)
+            if collector is not None:
+                runner.fleet_telemetry = collector.merged_rows(local_pid=0)
     finally:
         if client is not None:
             client.close()

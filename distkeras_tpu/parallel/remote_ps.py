@@ -167,6 +167,7 @@ class _TreeCodec:
         return clone
 
     def encode(self, tree, kind: str = "commit") -> list:
+        t0 = time.perf_counter()
         leaves = [np.asarray(l) for l in jax.tree_util.tree_flatten(
             device_get_batched(tree))[0]]
         if len(leaves) != len(self.specs):
@@ -182,16 +183,22 @@ class _TreeCodec:
             telemetry.histogram("comms.compress_ratio", op=kind,
                                 codec=self.wire.name).record(
                 self._raw_bytes / wire_bytes)
+        telemetry.histogram("profile.phase.encode_s", op=kind).record(
+            time.perf_counter() - t0)
         return blobs
 
     def decode(self, blobs: Sequence[bytes], kind: str = "commit"):
+        t0 = time.perf_counter()
         if len(blobs) != len(self.specs):
             raise ValueError(
                 f"message has {len(blobs)} blobs, codec expects "
                 f"{len(self.specs)}")
         leaves = [self.wire.decode(b, shape, dtype, kind=kind)
                   for b, (shape, dtype) in zip(blobs, self.specs)]
-        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+        tree = jax.tree_util.tree_unflatten(self.treedef, leaves)
+        telemetry.histogram("profile.phase.decode_s", op=kind).record(
+            time.perf_counter() - t0)
+        return tree
 
 
 class ParameterServerService:
@@ -216,9 +223,14 @@ class ParameterServerService:
                  token: Optional[str] = None,
                  codecs: Optional[Sequence[str]] = None,
                  membership: Optional[Membership] = None,
-                 shard: int = 0, num_shards: int = 1):
+                 shard: int = 0, num_shards: int = 1,
+                 collector=None):
         self.ps = ps
         self.codec = _TreeCodec(like)
+        # fleet telemetry sink (health/collector.py): mounted on the
+        # coordinator shard only; workers push row batches via the
+        # telemetry_put op, readers merge them via telemetry_merged
+        self.collector = collector
         # wire codecs this server will grant in the hello handshake
         # (None = everything registered); raw is always granted
         self.supported = tuple(codecs) if codecs is not None \
@@ -340,10 +352,22 @@ class ParameterServerService:
             sum(len(b) for b in blobs))
         telemetry.counter("comms.bytes_recv", op=op, side="server").inc(
             sum(len(b) for b in blobs))
+        ctx = telemetry.extract(header)
         t0 = time.perf_counter()
         try:
-            self._dispatch_op(conn, op, header, blobs,
-                              codec if codec is not None else self.codec)
+            if ctx is None:
+                self._dispatch_op(conn, op, header, blobs,
+                                  codec if codec is not None else self.codec)
+            else:
+                # adopt the caller's trace: server-side handling becomes a
+                # child span under the same trace_id, stitched across the
+                # socket by the traceparent header
+                with telemetry.use_trace(ctx):
+                    with telemetry.span("trace.server", op=op,
+                                        shard=self.shard):
+                        self._dispatch_op(
+                            conn, op, header, blobs,
+                            codec if codec is not None else self.codec)
         finally:
             telemetry.histogram("remote_ps.server.handle_s",
                                 op=op).record(time.perf_counter() - t0)
@@ -445,6 +469,22 @@ class ParameterServerService:
             center, clock = self.ps.pull()
             self._reply(conn, op, {"windows": merged, "clock": clock},
                         codec.encode(center, kind="pull"))
+        elif op == "telemetry_put":
+            # fleet telemetry aggregation (DESIGN.md §15): a worker pushes
+            # its span/metric rows; bounded on the collector side, a
+            # best-effort no-op when this shard mounts no collector
+            if self.collector is None:
+                self._reply(conn, op, {"ok": False, "accepted": 0,
+                                       "dropped": 0})
+            else:
+                res = self.collector.add_batch(header.get("pid", -1),
+                                               header.get("rows", []))
+                self._reply(conn, op, dict(res, ok=True))
+        elif op == "telemetry_merged":
+            rows = ([] if self.collector is None
+                    else self.collector.merged_rows())
+            self._reply(conn, op, {"ok": self.collector is not None,
+                                   "rows": rows})
         elif op in HEALTH_OPS:
             # live health plane (DESIGN.md §9): header-only introspection
             # sharing this connection's framing + token auth
@@ -586,6 +626,17 @@ class RemoteParameterServer:
                 f"client for {self._addr[0]}:{self._addr[1]} is closed")
         if self._sock is not None:
             return
+        if not self._ever_connected:
+            self._connect_locked()
+            self._ever_connected = True
+            return
+        # a RE-connect: visible as a tagged child span when the enclosing
+        # rpc is traced (same trace_id), and always as a counter
+        with telemetry.span("trace.reconnect"):
+            self._connect_locked()
+        telemetry.counter("remote_ps.client.reconnects").inc()
+
+    def _connect_locked(self) -> None:
         sock = socket.create_connection(self._addr, timeout=self._timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
@@ -611,9 +662,6 @@ class RemoteParameterServer:
             sock.close()
             raise
         self._sock = sock
-        if self._ever_connected:
-            telemetry.counter("remote_ps.client.reconnects").inc()
-        self._ever_connected = True
 
     def _teardown_locked(self) -> None:
         """Close the data connection and fail every pipelined waiter;
@@ -695,9 +743,24 @@ class RemoteParameterServer:
 
     def _roundtrip(self, header: dict, blobs=(),
                    timeout: Optional[float] = None) -> Tuple[dict, list]:
+        if telemetry.current_trace() is None:
+            return self._roundtrip_impl(header, blobs, timeout)
+        # one trace.rpc span per LOGICAL round-trip (retries are child
+        # spans inside it, never fresh rpc spans); the span's own context
+        # is what gets injected into the wire header below
+        with telemetry.span("trace.rpc", op=header.get("op", "?")):
+            return self._roundtrip_impl(header, blobs, timeout)
+
+    def _roundtrip_impl(self, header: dict, blobs=(),
+                        timeout: Optional[float] = None) -> Tuple[dict, list]:
         op = header.get("op", "?")
+        # inject ONCE, outside the retry loop: every re-send of this
+        # logical request carries the same traceparent, so the server side
+        # of a retried commit lands under the same parent span. Old peers
+        # ignore unknown header keys — raw-fallback-safe.
+        header = telemetry.inject(dict(header))
         if self.token is not None:
-            header = dict(header, token=self.token)
+            header["token"] = self.token
         timeout = self._op_timeout if timeout is None else timeout
         t0 = time.perf_counter()
         attempt = 0
@@ -719,7 +782,8 @@ class RemoteParameterServer:
                         f"{self._addr[1]} unavailable: {op} failed after "
                         f"{self.retry.max_retries} retries ({e})") from e
                 telemetry.counter("remote_ps.client.retries", op=op).inc()
-                time.sleep(self.retry.delay(attempt))
+                with telemetry.span("trace.retry", op=op, attempt=attempt):
+                    time.sleep(self.retry.delay(attempt))
         # rtt includes the wait for the shared connection: the contention
         # profile of the one-socket-per-process design is part of what a
         # STALENESS round wants to see
@@ -866,6 +930,26 @@ class RemoteParameterServer:
                                       timeout=timeout + 30.0)
         return (resp["windows"], self.codec.decode(blobs, kind="pull"),
                 resp["clock"])
+
+    # -- fleet telemetry (collector on the coordinator shard) --------------
+    def put_telemetry(self, pid: int, rows: list) -> dict:
+        """Push this process's telemetry rows to the coordinator's
+        collector. Best-effort BY DESIGN: telemetry must never fail a run,
+        so an old peer (unknown op) or an unreachable service comes back
+        as ``{"ok": False}`` instead of an exception."""
+        try:
+            resp, _ = self._roundtrip({"op": "telemetry_put",
+                                       "pid": int(pid),
+                                       "rows": list(rows)})
+        except (PSUnavailable, RuntimeError):
+            return {"ok": False, "accepted": 0, "dropped": 0}
+        return resp
+
+    def get_merged_telemetry(self) -> list:
+        """The coordinator's merged fleet rows, each tagged with its
+        origin ``pid``; [] when the peer mounts no collector."""
+        resp, _ = self._roundtrip({"op": "telemetry_merged"})
+        return resp.get("rows", [])
 
     def close(self) -> None:
         """Idempotent teardown (runner exit AND test teardown may both

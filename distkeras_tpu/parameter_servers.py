@@ -61,6 +61,7 @@ class ParameterServer:
         telemetry.counter("ps.commit.count").inc()
         telemetry.histogram("ps.commit.staleness").record(staleness)
         telemetry.histogram("ps.commit.handle_s").record(dur_s)
+        telemetry.histogram("profile.phase.fold_s").record(dur_s)
 
     def fold_weight(self, staleness: int) -> float:
         """The server rule's scale for a commit folded at the given
@@ -89,18 +90,23 @@ class ParameterServer:
         ANY server flavor, so convergence survives churn)."""
         delta = self._to_center_device(delta)
         t0 = time.perf_counter()
-        with self._lock:
-            at_fold = self.num_updates
-            staleness = at_fold - int(last_update)
-            if weight is None:
-                w = self.fold_weight(staleness)
-            elif callable(weight):
-                w = float(weight(staleness))
-            else:
-                w = float(weight)
-            self.center_variable = _fold(self.center_variable, delta,
-                                         jnp.float32(w))
-            self.num_updates += 1
+        # the fold leg of a distributed trace: when the caller carries a
+        # TraceContext (a traced commit arriving through remote_ps), this
+        # span chains under the same trace_id; untraced commits record a
+        # plain timeline event
+        with telemetry.span("trace.fold"):
+            with self._lock:
+                at_fold = self.num_updates
+                staleness = at_fold - int(last_update)
+                if weight is None:
+                    w = self.fold_weight(staleness)
+                elif callable(weight):
+                    w = float(weight(staleness))
+                else:
+                    w = float(weight)
+                self.center_variable = _fold(self.center_variable, delta,
+                                             jnp.float32(w))
+                self.num_updates += 1
         self._note_commit(staleness, time.perf_counter() - t0)
         return at_fold, w
 

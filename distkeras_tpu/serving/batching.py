@@ -50,16 +50,22 @@ class Request:
     """One row in flight: payload + the future its caller is waiting on.
 
     ``t_submit``/``deadline`` are ``time.monotonic`` seconds; ``deadline``
-    is None for no-timeout requests.
+    is None for no-timeout requests. ``trace``/``t_perf`` carry the
+    submitter's trace context and the submit instant on the span time
+    base (perf_counter) so the batcher thread can record queue-wait and
+    compute spans under the request's trace_id (DESIGN.md §15).
     """
 
-    __slots__ = ("x", "future", "t_submit", "deadline")
+    __slots__ = ("x", "future", "t_submit", "deadline", "trace", "t_perf")
 
-    def __init__(self, x, t_submit: float, deadline: Optional[float]):
+    def __init__(self, x, t_submit: float, deadline: Optional[float],
+                 trace=None):
         self.x = x
         self.future: Future = Future()
         self.t_submit = t_submit
         self.deadline = deadline
+        self.trace = trace
+        self.t_perf = time.perf_counter()
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline

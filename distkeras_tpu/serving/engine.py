@@ -208,7 +208,10 @@ class ServingEngine:
         timeout_s = (self.default_timeout_s if timeout_ms is None
                      else float(timeout_ms) / 1e3)
         deadline = None if timeout_s is None else now + timeout_s
-        return Request(row, now, deadline)
+        # the submitter's trace rides the Request so the batcher thread
+        # (which owns execution) can chain its spans under it
+        return Request(row, now, deadline,
+                       trace=telemetry.current_trace())
 
     def submit(self, x, timeout_ms: Optional[float] = None):
         """Enqueue one row; returns a ``concurrent.futures.Future`` whose
@@ -263,9 +266,21 @@ class ServingEngine:
         self._padding.record(bucket - n)
         fn = self._ensure_compiled(bucket)
         t0 = time.perf_counter()
+        for req in batch:
+            if req.trace is not None:
+                # queue-wait ends here: execution is starting
+                telemetry.record_trace_span(req.trace, "trace.queue_wait",
+                                            req.t_perf, t0 - req.t_perf)
         y = fn(self.params, jax.device_put(x, self._x_sharding))
         y_host = jax.tree.map(np.asarray, y)  # blocks until done
-        self._execute_h.record(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self._execute_h.record(dt)
+        for req in batch:
+            if req.trace is not None:
+                # the batched forward serves every row at once: traced
+                # rows share the batch's compute interval
+                telemetry.record_trace_span(req.trace, "trace.compute",
+                                            t0, dt, bucket=bucket)
         self._batches.inc()
         now = time.monotonic()
         if isinstance(y_host, np.ndarray):  # the common single-output case:

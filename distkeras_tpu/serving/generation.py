@@ -150,10 +150,11 @@ class GenerationResult:
 
 class _GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "eos_id", "stream", "future",
-                 "t_submit", "deadline", "generated", "last_token")
+                 "t_submit", "deadline", "generated", "last_token",
+                 "trace", "t_perf")
 
     def __init__(self, prompt, max_new_tokens, eos_id, stream,
-                 t_submit, deadline):
+                 t_submit, deadline, trace=None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
@@ -163,6 +164,11 @@ class _GenRequest:
         self.deadline = deadline
         self.generated: list = []
         self.last_token: int = 0
+        #: TraceContext this request's spans chain under (None = untraced);
+        #: t_perf is the submit instant on the span time base
+        #: (perf_counter — t_submit stays monotonic for deadline math)
+        self.trace = trace
+        self.t_perf = time.perf_counter()
 
 
 class GenerationEngine:
@@ -306,12 +312,19 @@ class GenerationEngine:
     def generate(self, prompt, *, max_new_tokens: Optional[int] = None,
                  eos_id: Optional[int] = None,
                  timeout_ms: Optional[float] = None,
-                 stream=None) -> Future:
+                 stream=None, trace=None) -> Future:
         """Queue one prompt; returns a Future of :class:`GenerationResult`.
 
         Raises :class:`QueueFull` when the admission queue is at
         capacity (slot exhaustion surfaces HERE, as backpressure, never
         as a device OOM) and :class:`EngineClosed` after shutdown.
+
+        ``trace``: a :class:`~distkeras_tpu.telemetry.TraceContext` the
+        request's spans (queue-wait, prefill, each decode iteration, the
+        request total) chain under; defaults to the submitting thread's
+        current trace (DESIGN.md §15). The scheduler thread records the
+        spans with this explicit context — it serves many requests per
+        iteration, so no single thread-local trace can be "current" there.
         """
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
@@ -332,7 +345,9 @@ class GenerationEngine:
         deadline = None if timeout_ms is None else now + timeout_ms / 1e3
         req = _GenRequest(prompt, mnt,
                           self.eos_id if eos_id is None else eos_id,
-                          stream, now, deadline)
+                          stream, now, deadline,
+                          trace=telemetry.current_trace()
+                          if trace is None else trace)
         with self._cv:
             if self._closed:
                 raise EngineClosed("engine is shut down; no new requests")
@@ -403,6 +418,10 @@ class GenerationEngine:
                     f"deadline passed {1e3 * (now - req.deadline):.1f} ms "
                     f"before admission"))
                 continue
+            if req.trace is not None:
+                telemetry.record_trace_span(
+                    req.trace, "trace.queue_wait", req.t_perf,
+                    time.perf_counter() - req.t_perf)
             slot = self.pool.allocate()
             self._prefill(req, slot)
             self._admitted_c.inc()
@@ -416,6 +435,7 @@ class GenerationEngine:
         ids = np.zeros((1, lb), np.int32)
         ids[0, :n] = req.prompt
         t0 = time.monotonic()
+        tp0 = time.perf_counter()
         new_pool, logits = self._prefill_exec[lb](
             self._params, self.pool.pool, ids, np.int32(slot), np.int32(n))
         self.pool.swap(new_pool)
@@ -425,6 +445,10 @@ class GenerationEngine:
         self._prefills_c.inc()
         self._prefill_h.record(now - t0)
         self._ttft_h.record(now - req.t_submit)
+        if req.trace is not None:
+            telemetry.record_trace_span(
+                req.trace, "trace.prefill", tp0,
+                time.perf_counter() - tp0, bucket=lb, slot=slot)
         req.generated.append(tok)
         req.last_token = tok
         self._stream_token(req, tok)
@@ -442,11 +466,13 @@ class GenerationEngine:
             tokens[i] = active[s].last_token
             lengths[i] = self.pool.lengths[s]
         t0 = time.monotonic()
+        tp0 = time.perf_counter()
         new_pool, logits = self._decode_exec[lane](
             self._params, self.pool.pool, slot_ids, tokens, lengths)
         self.pool.swap(new_pool)
         logits = np.asarray(logits)  # blocks until the step lands
         dt = time.monotonic() - t0
+        dt_p = time.perf_counter() - tp0
         self._steps_c.inc()
         self._tokens_c.inc(n)
         self._step_h.record(dt)
@@ -459,6 +485,14 @@ class GenerationEngine:
             tok = int(np.argmax(logits[i]))
             req.generated.append(tok)
             req.last_token = tok
+            if req.trace is not None:
+                # one decode iteration serves every lane at once, so each
+                # traced request gets a child span with the SHARED step
+                # interval — per-lane attribution of a batched step would
+                # be an invention, not a measurement
+                telemetry.record_trace_span(
+                    req.trace, "trace.decode", tp0, dt_p,
+                    step=len(req.generated), lanes=lane)
             self._stream_token(req, tok)
             reason = self._emit(req, s)
             if reason is not None:
@@ -480,6 +514,11 @@ class GenerationEngine:
             return None
         self.pool.free(slot)
         telemetry.counter("serving.decode.retired", reason=reason).inc()
+        if req.trace is not None:
+            telemetry.record_trace_span(
+                req.trace, "trace.request", req.t_perf,
+                time.perf_counter() - req.t_perf, reason=reason,
+                tokens=len(req.generated))
         req.future.set_result(
             GenerationResult(np.asarray(req.generated, np.int32), reason))
         return reason
@@ -496,6 +535,11 @@ class GenerationEngine:
                 self._expired_c.inc()
                 telemetry.counter("serving.decode.retired",
                                   reason="deadline").inc()
+                if req.trace is not None:
+                    telemetry.record_trace_span(
+                        req.trace, "trace.request", req.t_perf,
+                        time.perf_counter() - req.t_perf,
+                        reason="deadline", tokens=len(req.generated))
                 req.future.set_exception(DeadlineExceeded(
                     f"deadline passed after {len(req.generated)} tokens"))
         self._active_g.set(len(active))

@@ -40,6 +40,7 @@ from __future__ import annotations
 import queue
 import socket
 import threading
+import time
 from typing import Optional, Tuple
 
 import numpy as np
@@ -191,6 +192,14 @@ class ServingServer:
             send_message(conn, {"error": f"unknown op {op!r}",
                                 "kind": "bad_request"})
 
+    @staticmethod
+    def _request_trace(header: dict):
+        """One trace per request (DESIGN.md §15): adopt the caller's wire
+        context when the header carries one, else mint a fresh root — so
+        a serving request is traceable whether or not the client traces."""
+        ctx = telemetry.extract(header)
+        return telemetry.TraceContext.new_root() if ctx is None else ctx
+
     def _infer(self, conn, header: dict, blobs: list):
         if len(blobs) != 1:
             raise ValueError(f"infer expects 1 blob, got {len(blobs)}")
@@ -202,11 +211,17 @@ class ServingServer:
                 f"rows of shape {shape[1:]} sent to an engine serving "
                 f"{self.engine.input_shape}")
         timeout_ms = header.get("timeout_ms")
-        futures = self.engine.submit_many(x, timeout_ms=timeout_ms)
-        # wall-clock bound for the blocking result() calls: the per-request
-        # deadline (if any) plus slack for the executing batch to finish
-        wait_s = None if timeout_ms is None else timeout_ms / 1e3 + 30.0
-        rows = [np.asarray(f.result(timeout=wait_s)) for f in futures]
+        with telemetry.use_trace(self._request_trace(header)):
+            with telemetry.span("trace.request", op="infer",
+                                rows=int(shape[0])):
+                futures = self.engine.submit_many(x, timeout_ms=timeout_ms)
+                # wall-clock bound for the blocking result() calls: the
+                # per-request deadline (if any) plus slack for the
+                # executing batch to finish
+                wait_s = (None if timeout_ms is None
+                          else timeout_ms / 1e3 + 30.0)
+                rows = [np.asarray(f.result(timeout=wait_s))
+                        for f in futures]
         out = np.stack(rows) if rows else np.empty((0,), np.float32)
         send_message(conn, {"shape": list(out.shape), "dtype": str(out.dtype)},
                      [np.ascontiguousarray(out).tobytes()])
@@ -228,8 +243,12 @@ class ServingServer:
             kw["eos_id"] = int(header["eos_id"])
         if header.get("timeout_ms") is not None:
             kw["timeout_ms"] = float(header["timeout_ms"])
+        # the request's trace: queue-wait/prefill/decode spans come from
+        # the engine (explicit context, scheduler thread); the stream
+        # flushes below are the server's own children of the same trace
+        ctx = self._request_trace(header)
         q: "queue.SimpleQueue[int]" = queue.SimpleQueue()
-        fut = self.generator.generate(prompt, stream=q.put, **kw)
+        fut = self.generator.generate(prompt, stream=q.put, trace=ctx, **kw)
         while True:
             try:
                 chunk = [q.get(timeout=0.05)]
@@ -245,7 +264,11 @@ class ServingServer:
                     chunk.append(q.get_nowait())
                 except queue.Empty:
                     break
+            t0 = time.perf_counter()
             send_message(conn, {"stream": True, "tokens": chunk})
+            telemetry.record_trace_span(
+                ctx, "trace.stream_flush", t0, time.perf_counter() - t0,
+                tokens=len(chunk))
         exc = fut.exception()
         if exc is not None:
             send_message(conn, {"error": str(exc),
@@ -284,8 +307,11 @@ class ServingClient:
         self._lock = threading.Lock()
 
     def _roundtrip(self, header: dict, blobs=()) -> Tuple[dict, list]:
+        # a caller inside an active trace stitches the server's spans
+        # under its own trace_id; no-op (and raw-peer-safe) otherwise
+        header = telemetry.inject(dict(header))
         if self.token is not None:
-            header = dict(header, token=self.token)
+            header["token"] = self.token
         # by-design: the lock held over send+recv serializes callers on
         # the single shared connection (documented contention profile)
         with self._lock:
@@ -321,6 +347,7 @@ class ServingClient:
             header["timeout_ms"] = float(timeout_ms)
         if eos_id is not None:
             header["eos_id"] = int(eos_id)
+        header = telemetry.inject(header)
         if self.token is not None:
             header = dict(header, token=self.token)
         streamed = []

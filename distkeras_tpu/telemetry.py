@@ -43,9 +43,11 @@ recency-weighted once a shard overflows).
 
 from __future__ import annotations
 
+import atexit
 import collections
 import contextlib
 import json
+import os
 import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional, Tuple
@@ -55,6 +57,8 @@ __all__ = [
     "get_registry", "install", "uninstall", "reset",
     "counter", "gauge", "histogram", "span", "load_jsonl",
     "METRIC_NAMES", "METRIC_PREFIXES", "declared_kind",
+    "TraceContext", "current_trace", "use_trace", "inject", "extract",
+    "record_trace_span", "flush_at_exit",
 ]
 
 SCHEMA_VERSION = 1
@@ -179,6 +183,26 @@ METRIC_NAMES = {
     "serving.decode.ttft_s": "histogram",
     # trainer lifecycle
     "trainer.training_time_s": "gauge",
+    # fleet telemetry collector (health/collector.py; lives on shard 0)
+    "collector.batches": "counter",
+    "collector.dropped_batches": "counter",
+    "collector.dropped_rows": "counter",
+    "collector.processes": "gauge",
+    "collector.rows": "counter",
+    # step-time decomposition (DESIGN.md §15): the canonical phase
+    # vocabulary attribution.py renders. Also covered by the
+    # "profile.phase." family so per-worker variants stay legal.
+    "profile.phase.bookkeep_s": "histogram",
+    "profile.phase.collective_s": "histogram",
+    "profile.phase.commit_s": "histogram",
+    "profile.phase.compute_s": "histogram",
+    "profile.phase.data_wait_s": "histogram",
+    "profile.phase.decode_s": "histogram",
+    "profile.phase.encode_s": "histogram",
+    "profile.phase.fold_s": "histogram",
+    "profile.phase.h2d_s": "histogram",
+    "profile.phase.pull_s": "histogram",
+    "profile.phase.window_s": "histogram",
     # span names (the `with span("..."):` vocabulary; each also emits a
     # `span.<name>.duration_s` histogram via the prefix family below)
     "serving.compile": "span",
@@ -190,6 +214,24 @@ METRIC_NAMES = {
     "trainer.finalize": "span",
     "trainer.init": "span",
     "trainer.stage": "span",
+    # distributed-trace span vocabulary (DESIGN.md §15). One trace stitches
+    # worker window -> transport (retries/reconnects) -> shard folds, or a
+    # generate request -> queue wait -> prefill -> decode iterations.
+    "trace.commit": "span",
+    "trace.compute": "span",
+    "trace.decode": "span",
+    "trace.fold": "span",
+    "trace.prefill": "span",
+    "trace.pull": "span",
+    "trace.queue_wait": "span",
+    "trace.reconnect": "span",
+    "trace.request": "span",
+    "trace.retry": "span",
+    "trace.rpc": "span",
+    "trace.server": "span",
+    "trace.shard": "span",
+    "trace.stream_flush": "span",
+    "trace.window": "span",
 }
 
 #: Dynamic name families: any name starting with one of these prefixes is
@@ -200,6 +242,10 @@ METRIC_PREFIXES = {
     "span.": "histogram",
     # device memory stats keyed by whatever the backend reports
     "observability.hbm_": "gauge",
+    # distributed-trace span names (DESIGN.md §15)
+    "trace.": "span",
+    # step-time decomposition phases (benchmarks/attribution.py)
+    "profile.phase.": "histogram",
 }
 
 
@@ -214,6 +260,123 @@ def declared_kind(name: str):
         if name.startswith(prefix):
             return kind
     return None
+
+# -- distributed trace context (DESIGN.md §15) ------------------------------
+
+#: Header key carrying the trace context on every wire protocol
+#: (remote_ps request headers, serving/generation framing). W3C
+#: traceparent shape: ``00-<32 hex trace_id>-<16 hex span_id>-01``.
+#: Servers ignore unknown header keys, so carrying it is raw-fallback-safe
+#: for peers that predate tracing.
+TRACEPARENT_KEY = "traceparent"
+
+#: Optional baggage dict riding next to the traceparent (low-cardinality
+#: request annotations only: worker id, window number — never values).
+TRACE_BAGGAGE_KEY = "tracebaggage"
+
+#: Reserved span-label keys that carry trace identity. ``record_span``
+#: strips them before minting the ``span.<name>.duration_s`` histogram
+#: (per-trace ids would mint one histogram per span) and the row emitters
+#: hoist them to top-level row fields.
+_TRACE_KEYS = ("trace_id", "span_id", "parent_id")
+
+
+class TraceContext:
+    """A position in a distributed trace: ``trace_id`` names the whole
+    request/window, ``span_id`` names the current span, ``baggage`` carries
+    low-cardinality annotations along the entire trace.
+
+    Identity is process-agnostic (ids are random hex minted by
+    ``os.urandom``), so a context can be serialized into a wire header with
+    :func:`inject`, recovered with :func:`extract`, and adopted on any
+    thread with :func:`use_trace` — spans recorded while a context is
+    current chain parent -> child automatically."""
+
+    __slots__ = ("trace_id", "span_id", "baggage")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 baggage: Optional[Dict[str, str]] = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.baggage = dict(baggage) if baggage else {}
+
+    @classmethod
+    def new_root(cls, **baggage: str) -> "TraceContext":
+        return cls(os.urandom(16).hex(), os.urandom(8).hex(), baggage)
+
+    def child(self) -> "TraceContext":
+        """A new span position under the same trace (baggage shared)."""
+        return TraceContext(self.trace_id, os.urandom(8).hex(), self.baggage)
+
+    def to_traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    @classmethod
+    def from_traceparent(cls, value, baggage: Optional[Dict[str, str]] = None):
+        """Parse a traceparent string; None on anything malformed (a
+        garbled header must never fail the request it rode in on)."""
+        parts = value.split("-") if isinstance(value, str) else []
+        if len(parts) != 4 or parts[0] != "00":
+            return None
+        trace_id, span_id = parts[1], parts[2]
+        if len(trace_id) != 32 or len(span_id) != 16:
+            return None
+        try:
+            int(trace_id, 16)
+            int(span_id, 16)
+        except ValueError:
+            return None
+        return cls(trace_id, span_id, baggage)
+
+    def __repr__(self) -> str:
+        return f"TraceContext({self.to_traceparent()!r})"
+
+
+_trace_local = threading.local()
+
+
+def current_trace() -> Optional[TraceContext]:
+    """The calling thread's active trace context, or None (untraced)."""
+    return getattr(_trace_local, "ctx", None)
+
+
+@contextlib.contextmanager
+def use_trace(ctx: Optional[TraceContext]):
+    """Adopt ``ctx`` as the calling thread's current trace for the block.
+    Threads do not inherit context — fan-out sites (shard pools, handler
+    threads) adopt the parent explicitly, which is what keeps span
+    parentage honest across thread boundaries."""
+    prev = getattr(_trace_local, "ctx", None)
+    _trace_local.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _trace_local.ctx = prev
+
+
+def inject(header: Dict[str, Any],
+           ctx: Optional[TraceContext] = None) -> Dict[str, Any]:
+    """Write ``ctx`` (default: the thread's current trace) into a wire
+    header dict in W3C style; no-op when untraced. Returns ``header``."""
+    if ctx is None:
+        ctx = current_trace()
+    if ctx is not None:
+        header[TRACEPARENT_KEY] = ctx.to_traceparent()
+        if ctx.baggage:
+            header[TRACE_BAGGAGE_KEY] = dict(ctx.baggage)
+    return header
+
+
+def extract(header: Dict[str, Any]) -> Optional[TraceContext]:
+    """Recover a TraceContext from a wire header; None when absent or
+    malformed. The inverse of :func:`inject`."""
+    raw = header.get(TRACEPARENT_KEY)
+    if not raw:
+        return None
+    bag = header.get(TRACE_BAGGAGE_KEY)
+    return TraceContext.from_traceparent(
+        raw, bag if isinstance(bag, dict) else None)
+
 
 #: Per-thread-shard ring size for histograms. 1024 doubles (per writing
 #: thread) bounds memory while keeping p50/p95 meaningful for the window
@@ -230,6 +393,22 @@ def _full_name(name: str, labels: Dict[str, Any]) -> str:
         return name
     inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
     return f"{name}{{{inner}}}"
+
+
+def _span_row(name: str, t0: float, dur_s: float,
+              labels: Dict[str, Any]) -> dict:
+    """Span event -> row dict. Trace identity keys are hoisted out of the
+    labels into top-level fields so consumers (merge views, Chrome export)
+    key on ``row["trace_id"]`` while labels stay low-cardinality."""
+    row = {"kind": "span", "name": name, "labels": labels,
+           "t0": t0, "dur_s": dur_s}
+    if labels and "trace_id" in labels:
+        row["labels"] = {k: v for k, v in labels.items()
+                        if k not in _TRACE_KEYS}
+        for k in _TRACE_KEYS:
+            if k in labels:
+                row[k] = labels[k]
+    return row
 
 
 class _Metric:
@@ -456,22 +635,27 @@ class MetricsRegistry:
     def record_span(self, name: str, t0: float, dur_s: float,
                     labels: Dict[str, Any]) -> None:
         self.spans.append((name, t0, dur_s, labels))
-        self.histogram(f"span.{name}.duration_s", **labels).record(dur_s)
+        hist_labels = labels
+        if labels and "trace_id" in labels:
+            # trace ids are per-span unique: keeping them would mint one
+            # histogram per event. Identity stays on the timeline only.
+            hist_labels = {k: v for k, v in labels.items()
+                           if k not in _TRACE_KEYS}
+        self.histogram(f"span.{name}.duration_s", **hist_labels).record(dur_s)
 
     # -- export -----------------------------------------------------------
     def rows(self) -> Iterator[dict]:
         for m in list(self._metrics.values()):
             yield m.row()
         for name, t0, dur, labels in list(self.spans):
-            yield {"kind": "span", "name": name, "labels": labels,
-                   "t0": t0, "dur_s": dur}
+            yield _span_row(name, t0, dur, labels)
 
     def recent_spans(self, limit: int = 100) -> List[dict]:
         """The newest ``limit`` span events as row dicts (oldest first) —
         the live ``recent-spans`` introspection endpoint's payload."""
         events = list(self.spans)[-max(0, int(limit)):]
-        return [{"kind": "span", "name": name, "labels": labels,
-                 "t0": t0, "dur_s": dur} for name, t0, dur, labels in events]
+        return [_span_row(name, t0, dur, labels)
+                for name, t0, dur, labels in events]
 
     def snapshot(self) -> dict:
         """Structured view for ``Trainer.get_telemetry()`` and the live
@@ -490,8 +674,8 @@ class MetricsRegistry:
         out: dict = {"counters": {}, "gauges": {}, "histograms": {},
                      "spans": []}
         rows = [m.row() for m in metrics] + [
-            {"kind": "span", "name": name, "labels": labels,
-             "t0": t0, "dur_s": dur} for name, t0, dur, labels in spans]
+            _span_row(name, t0, dur, labels)
+            for name, t0, dur, labels in spans]
         for row in rows:
             kind = row["kind"]
             if kind == "span":
@@ -598,13 +782,79 @@ def histogram(name: str, **labels):
 def span(name: str, **labels):
     """Time a block into ``span.<name>.duration_s`` (+ the event timeline).
     Timestamps are ``time.monotonic``-class (perf_counter); pairs of events
-    order correctly within a process but mean nothing across processes."""
+    order correctly within a process but mean nothing across processes.
+
+    When the calling thread has an active :class:`TraceContext` (via
+    :func:`use_trace` or an enclosing ``span``), the event is recorded as a
+    child of that context, a fresh child context is made current for the
+    duration of the block, and that context is yielded (None when
+    untraced) — so nested spans chain parent -> child and the context can
+    be injected into outbound wire headers."""
     reg = _installed
     if reg is None:
-        yield
+        yield None
         return
+    parent = current_trace()
+    if parent is None:
+        t0 = time.perf_counter()
+        try:
+            yield None
+        finally:
+            reg.record_span(name, t0, time.perf_counter() - t0, labels)
+        return
+    ctx = parent.child()
+    labels = dict(labels, trace_id=ctx.trace_id, span_id=ctx.span_id,
+                  parent_id=parent.span_id)
+    _trace_local.ctx = ctx
     t0 = time.perf_counter()
     try:
-        yield
+        yield ctx
     finally:
+        _trace_local.ctx = parent
         reg.record_span(name, t0, time.perf_counter() - t0, labels)
+
+
+def record_trace_span(ctx: Optional["TraceContext"], name: str, t0: float,
+                      dur_s: float, **labels) -> None:
+    """Record one already-measured span as a child of ``ctx`` (plain
+    untraced event when ctx is None). For code whose span boundaries do
+    not nest as a ``with`` block — e.g. the generation scheduler, where a
+    request's queue-wait starts on the submitting thread and ends
+    iterations later on the scheduler thread. ``t0`` must be a
+    ``time.perf_counter`` reading (the registry's span time base)."""
+    reg = _installed
+    if reg is None:
+        return
+    if ctx is not None:
+        child = ctx.child()
+        labels = dict(labels, trace_id=child.trace_id,
+                      span_id=child.span_id, parent_id=ctx.span_id)
+    reg.record_span(name, t0, dur_s, labels)
+
+
+# -- crash-safe artifact flush ----------------------------------------------
+
+_flush_state: Dict[str, Optional[str]] = {"path": None}
+
+
+def flush_at_exit(path: str) -> str:
+    """Arrange for the installed registry to be dumped to ``path`` at
+    interpreter exit, so the span/metric artifact survives a crashed or
+    watchdog-killed run (``checkpoint_and_raise`` unwinds through here).
+    Idempotent: one atexit hook total, the most recent path wins. The hook
+    is a no-op when telemetry is uninstalled at exit time."""
+    first = _flush_state["path"] is None
+    _flush_state["path"] = str(path)
+    if first:
+        atexit.register(_flush_now)
+    return _flush_state["path"]
+
+
+def _flush_now() -> Optional[str]:
+    path, reg = _flush_state["path"], _installed
+    if path is None or reg is None:
+        return None
+    try:
+        return reg.dump_jsonl(path)
+    except OSError:
+        return None  # a dead disk at exit must not mask the real failure

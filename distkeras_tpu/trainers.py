@@ -85,6 +85,12 @@ class Trainer:
         # where to dump the telemetry JSONL artifact when train() finishes
         # (None: keep it in-process only — read it with get_telemetry())
         self.telemetry_path = telemetry_path
+        if telemetry_path is not None:
+            # crash-safe: a run killed mid-train (watchdog
+            # checkpoint_and_raise, OOM, SIGTERM-mediated exit) still
+            # leaves the artifact that explains it; the normal _stop()
+            # dump later overwrites the same path with the same registry
+            telemetry.flush_at_exit(telemetry_path)
 
         self.tx = opt_lib.get(worker_optimizer, learning_rate)
         # mixed-precision policy (DESIGN.md §11): validate EARLY, stamp the
