@@ -274,6 +274,86 @@ def run_evidence(out_path: str, workers: int = 2, rounds: int = 4,
     return result
 
 
+def run_recorder_evidence(out_path: str, workers: int = 2,
+                          rounds: int = 4, batch: int = 8, window: int = 2,
+                          repeats: int = 2,
+                          max_overhead: float = 0.02) -> dict:
+    """Flight-recorder cost evidence: the same paired off/on harness as
+    :func:`run_evidence`, but the toggle is the telemetry RECORDER sink
+    (off = no recorder installed, on = a fresh
+    :class:`~distkeras_tpu.health.recorder.FlightRecorder`) with tracing
+    held constant. What the "on" side pays per window: one
+    ``window_profile`` ring append + the span-event forwards."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distkeras_tpu import telemetry
+    from distkeras_tpu.health import recorder as recorder_mod
+    from distkeras_tpu.health.recorder import FlightRecorder
+    from distkeras_tpu.models import resnet18
+    from distkeras_tpu.parallel import host_async, strategies
+
+    model = resnet18(num_classes=10, dtype=jnp.float32)
+    runner = host_async.HostAsyncRunner(
+        model, "categorical_crossentropy", optax.sgd(0.05),
+        strategies.get("dynsgd"), window=window)
+    shards = _staged_shards(workers, rounds, batch, window)
+    init_params = model.init(
+        jax.random.key(0), jnp.zeros((batch, 32, 32, 3), jnp.float32),
+        train=False)["params"]
+
+    telemetry.reset()
+    runner.trace = False
+    telemetry.set_recorder(None)
+    runner.run(init_params, [shards])  # warmup: compile the window_fn
+
+    off_runs, on_runs = [], []
+    ring_events = 0
+    try:
+        for _ in range(repeats):
+            telemetry.set_recorder(None)
+            off_runs.append(_measured_run(runner, init_params, shards[:1]))
+            rec = FlightRecorder()
+            telemetry.set_recorder(rec)
+            on_runs.append(_measured_run(runner, init_params, shards[:1]))
+            ring_events = len(rec.events())
+    finally:
+        # put the process's default-on recorder back whatever happens
+        telemetry.set_recorder(recorder_mod.get_recorder())
+        telemetry.uninstall()
+    pairs = sorted(on["window_p50_s"] / off["window_p50_s"] - 1.0
+                   for off, on in zip(off_runs, on_runs))
+    overhead = pairs[len(pairs) // 2] if len(pairs) % 2 else (
+        pairs[len(pairs) // 2 - 1] + pairs[len(pairs) // 2]) / 2
+    off_s = min(r["window_p50_s"] for r in off_runs)
+    on_s = min(r["window_p50_s"] for r in on_runs)
+
+    lines = [
+        {"kind": "meta", "tool": "recorder_overhead", "model": "resnet18",
+         "workers": 1, "rounds": rounds, "batch": batch,
+         "window": window, "platform": jax.default_backend()},
+        {"kind": "overhead",
+         "window_p50_off_s": round(off_s, 6),
+         "window_p50_on_s": round(on_s, 6),
+         "pair_ratios": [round(p, 6) for p in pairs],
+         "overhead_frac": round(overhead, 6),
+         "repeats": repeats,
+         "ring_events_per_run": ring_events},
+    ]
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
+        for line in lines:
+            f.write(json.dumps(line) + "\n")
+    print(f"flight-recorder overhead: {100 * overhead:+.2f}% of median "
+          f"window ({off_s * 1e3:.1f} ms off -> {on_s * 1e3:.1f} ms on); "
+          f"{ring_events} ring events per run\nwrote {out_path}")
+    ok = overhead <= max_overhead
+    if not ok:
+        print(f"FAIL: recorder overhead {overhead:.4f} > {max_overhead}")
+    return {"overhead_frac": overhead, "ok": ok}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="phase attribution for host_async windows")
@@ -282,11 +362,16 @@ def main(argv=None):
     ap.add_argument("--run", action="store_true",
                     help="execute the resnet18 CPU evidence run "
                          "(tracing on vs off) instead of rendering")
+    ap.add_argument("--recorder-overhead", action="store_true",
+                    help="execute the flight-recorder off/on paired cost "
+                         "run instead (same harness, recorder sink as "
+                         "the toggle)")
     ap.add_argument("--out",
-                    default=os.path.join(os.path.dirname(
-                        os.path.abspath(__file__)),
-                        "results", "pr10_attribution.jsonl"),
-                    help="--run: evidence JSONL destination")
+                    default=None,
+                    help="evidence JSONL destination (default "
+                         "results/pr10_attribution.jsonl for --run, "
+                         "results/pr11_recorder_overhead.jsonl for "
+                         "--recorder-overhead)")
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--rounds", type=int, default=4)
     ap.add_argument("--batch", type=int, default=8)
@@ -299,9 +384,21 @@ def main(argv=None):
     ap.add_argument("--max-overhead", type=float, default=0.02,
                     help="--run: fail above this tracing-on overhead")
     args = ap.parse_args(argv)
+    results_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results")
+    if args.recorder_overhead:
+        out = args.out or os.path.join(results_dir,
+                                       "pr11_recorder_overhead.jsonl")
+        result = run_recorder_evidence(
+            out, workers=args.workers, rounds=args.rounds,
+            batch=args.batch, window=args.window, repeats=args.repeats,
+            max_overhead=args.max_overhead)
+        sys.exit(0 if result["ok"] else 1)
     if args.run:
+        out = args.out or os.path.join(results_dir,
+                                       "pr10_attribution.jsonl")
         result = run_evidence(
-            args.out, workers=args.workers, rounds=args.rounds,
+            out, workers=args.workers, rounds=args.rounds,
             batch=args.batch, window=args.window, repeats=args.repeats,
             min_coverage=args.min_coverage, max_overhead=args.max_overhead)
         sys.exit(0 if result["ok"] else 1)

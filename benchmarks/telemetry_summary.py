@@ -187,17 +187,36 @@ def main(argv=None):
                          "trace_id (rows from the i-th artifact default "
                          "to pid=i when untagged)")
     args = ap.parse_args(argv)
-    if len(args.paths) > 1 and not args.merge:
+    # per-process family expansion: flush_at_exit suffixes artifacts with
+    # .p{process_index}, so `run.jsonl` names a FAMILY on a shared FS —
+    # expand a missing bare path to its sorted .p* siblings, each tagged
+    # with the pid parsed from its suffix
+    paths = []
+    for path in args.paths:
+        if not os.path.exists(path):
+            import glob as glob_lib
+            import re
+
+            family = sorted(
+                p for p in glob_lib.glob(path + ".p*")
+                if re.fullmatch(r"\.p\d+", p[len(path):]))
+            if family:
+                paths.extend((p, int(p.rsplit(".p", 1)[1])) for p in family)
+                continue
+        paths.append((path, None))
+    if len(paths) > 1 and not args.merge:
         sys.exit("multiple artifacts only make sense with --merge")
     rows = []
-    for i, path in enumerate(args.paths):
+    for i, (path, pid) in enumerate(paths):
         try:
             file_rows = load_rows(path)
         except OSError as e:
             sys.exit(f"cannot read {path}: {e}")
+        if pid is None:
+            pid = i
         for r in file_rows:
-            if "pid" not in r and len(args.paths) > 1:
-                r = dict(r, pid=i)
+            if "pid" not in r and len(paths) > 1:
+                r = dict(r, pid=pid)
             rows.append(r)
     if not rows:
         sys.exit(f"{args.paths[0]}: empty artifact")

@@ -47,8 +47,12 @@ _CONSUMER_PATHS = (
     "benchmarks/telemetry_summary.py",
     "benchmarks/health_probe.py",
     "benchmarks/attribution.py",
+    "benchmarks/regression_gate.py",
     "distkeras_tpu/health/export.py",
     "distkeras_tpu/health/endpoints.py",
+    "distkeras_tpu/health/slo.py",
+    "distkeras_tpu/health/recorder.py",
+    "distkeras_tpu/health/cli.py",
 )
 _FAULT_FUNCS = {"inject", "apply", "clear_injections",
                 "inject_chaos", "chaos", "clear_chaos"}

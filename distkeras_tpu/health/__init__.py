@@ -28,16 +28,21 @@ from distkeras_tpu.health.endpoints import (HEALTH_OPS, HealthClient,
                                             handle_health_op)
 from distkeras_tpu.health.heartbeat import (HeartbeatPublisher,
                                             StragglerDetector)
+# importing the recorder module installs the default-on FlightRecorder
+# into telemetry's sink slot (the package is loaded by every trainer path)
+from distkeras_tpu.health.recorder import FlightRecorder
+from distkeras_tpu.health.slo import AlertEvent, SloEngine, SloSpec
 from distkeras_tpu.health.watchdog import (POLICIES, Divergence, NaNLoss,
-                                           Stall, TrainingWatchdog,
-                                           WatchdogError)
+                                           SloBreach, Stall,
+                                           TrainingWatchdog, WatchdogError)
 
 __all__ = [
     "HealthConfig", "resolve",
     "HEALTH_OPS", "HealthClient", "handle_health_op",
     "HeartbeatPublisher", "StragglerDetector",
     "POLICIES", "TrainingWatchdog", "WatchdogError",
-    "NaNLoss", "Divergence", "Stall",
+    "NaNLoss", "Divergence", "Stall", "SloBreach",
+    "FlightRecorder", "SloSpec", "SloEngine", "AlertEvent",
 ]
 
 

@@ -10,13 +10,23 @@ Point it at a running parameter-server service or serving front-end::
 Commands: ``status`` (one liveness digest), ``metrics`` (full snapshot as
 JSON or Prometheus text), ``spans`` (recent span events; ``--chrome PATH``
 writes a chrome://tracing file instead), ``watch`` (poll ``status``
-forever — or ``--count N`` times — printing one compact line per poll).
+forever — or ``--count N`` times / ``--once`` for scripting — printing
+one compact line per poll; ``--interval`` must be > 0).
 ``watch --table`` renders one row PER WORKER per poll instead (heartbeat
 age, windows completed, window rate over the poll interval, staleness,
-degraded-window count, straggler flag), preferring the coordinator's
-fleet-merged collector view (``telemetry_merged``) and falling back to
-the peer's local snapshot when the service doesn't carry a collector.
-Pass ``--token`` when the service was started with a shared secret.
+degraded-window count, active SLO alerts, straggler flag), preferring the
+coordinator's fleet-merged collector view (``telemetry_merged``) and
+falling back to the peer's local snapshot when the service doesn't carry
+a collector. Pass ``--token`` when the service was started with a shared
+secret.
+
+The address-less ``postmortem`` subcommand works on files instead of a
+live service: it globs the per-process flight-recorder bundles
+(``postmortem*.json.p*``) a crashed run left next to its checkpoints and
+renders one merged cross-process timeline::
+
+    python -m distkeras_tpu.health.cli postmortem /ckpt/dir
+    python -m distkeras_tpu.health.cli postmortem /ckpt/dir --json out.json
 """
 
 from __future__ import annotations
@@ -52,9 +62,26 @@ def _fleet_rows(client: HealthClient) -> list:
         return _snapshot_rows(client.metrics_snapshot())
 
 
-def _watch_table(workers: dict, prev: dict, interval: float) -> str:
+def _fleet_alerts(rows: list) -> list:
+    """Names of SLOs whose ``health.alerts.active`` gauge is set and that
+    carry no worker label (fleet-wide breaches; per-worker ones land in
+    their row's ALERTS column via :func:`worker_table`)."""
+    out = []
+    for r in rows:
+        labels = r.get("labels") or {}
+        if (r.get("kind") == "gauge"
+                and r.get("name") == "health.alerts.active"
+                and r.get("value") and "worker" not in labels):
+            slo = labels.get("slo", "?")
+            if slo not in out:
+                out.append(slo)
+    return out
+
+
+def _watch_table(workers: dict, prev: dict, interval: float,
+                 fleet_alerts: list = ()) -> str:
     cols = ("worker", "hb_age", "windows", "win/s", "staleness",
-            "degraded", "flag")
+            "degraded", "alerts", "flag")
     lines = [time.strftime("%H:%M:%S") + "  " +
              " ".join(f"{c:>9s}" for c in cols)]
     for worker in sorted(workers, key=str):
@@ -66,11 +93,13 @@ def _watch_table(workers: dict, prev: dict, interval: float) -> str:
         age = w.get("age_s")
         vals = (worker, "-" if age is None else f"{age:.1f}s",
                 str(windows), rate, str(w.get("staleness", "-")),
-                str(w.get("degraded", 0)),
+                str(w.get("degraded", 0)), str(w.get("alerts", 0)),
                 "STRAGGLER" if w.get("straggler") else "ok")
         lines.append("          " + " ".join(f"{v:>9s}" for v in vals))
     if len(lines) == 1:
         lines.append("          (no workers reporting yet)")
+    if fleet_alerts:
+        lines.append(f"          ALERTS: {', '.join(fleet_alerts)}")
     return "\n".join(lines)
 
 
@@ -84,6 +113,7 @@ def _watch_line(status: dict) -> str:
         f"max_hb_age={max(ages):.1f}s" if ages else "max_hb_age=-",
         f"stragglers={','.join(status.get('stragglers', [])) or '-'}",
         f"watchdog={'TRIPPED' if status.get('watchdog_tripped') else 'ok'}",
+        f"alerts={len(status.get('alerts', []) or [])}",
     ]
     for key in ("clock", "queue_depth"):
         if key in status:
@@ -91,11 +121,47 @@ def _watch_line(status: dict) -> str:
     return "  ".join(parts)
 
 
+def _postmortem_main(argv: list) -> int:
+    """The address-less subcommand: merge + render recorder bundles."""
+    from distkeras_tpu.health import recorder
+
+    ap = argparse.ArgumentParser(
+        prog="python -m distkeras_tpu.health.cli postmortem",
+        description="Merge the per-process flight-recorder bundles "
+                    "(postmortem*.json.p*) a crashed run left behind "
+                    "into one cross-process timeline.")
+    ap.add_argument("directory",
+                    help="directory holding the bundle family (usually "
+                         "the run's checkpoint dir)")
+    ap.add_argument("--limit", type=int, default=60,
+                    help="timeline events to render (newest first)")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="also write the merged timeline as JSON")
+    args = ap.parse_args(argv)
+    paths = recorder.find_bundles(args.directory)
+    if not paths:
+        print(f"no postmortem bundles under {args.directory}",
+              file=sys.stderr)
+        return 1
+    merged = recorder.merge_bundles(paths)
+    print(recorder.render_timeline(merged, limit=args.limit))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(merged, f)
+        print(f"wrote merged timeline to {args.json}")
+    return 0
+
+
 def main(argv: Optional[list] = None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if argv and argv[0] == "postmortem":
+        return _postmortem_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m distkeras_tpu.health.cli",
         description="Query the live health endpoints of a running "
-                    "parameter-server or serving service.")
+                    "parameter-server or serving service. The file-based "
+                    "`postmortem <dir>` subcommand merges crash bundles "
+                    "instead (see `postmortem --help`).")
     ap.add_argument("address", help="host:port of the service")
     ap.add_argument("command", choices=("status", "metrics", "spans",
                                         "watch"))
@@ -109,14 +175,24 @@ def main(argv: Optional[list] = None) -> int:
                     help="write spans as a Chrome trace file instead of "
                          "printing JSON")
     ap.add_argument("--interval", type=float, default=2.0,
-                    help="seconds between polls (watch command)")
+                    help="seconds between polls (watch command; "
+                         "must be > 0)")
     ap.add_argument("--count", type=int, default=0,
                     help="stop watch after N polls (0 = forever)")
+    ap.add_argument("--once", action="store_true",
+                    help="watch: poll exactly once and exit (for "
+                         "scripts/CI; same as --count 1)")
     ap.add_argument("--table", action="store_true",
                     help="watch: one row per worker (heartbeat age, "
-                         "window rate, staleness, degraded count) from "
-                         "the fleet-merged collector view when available")
+                         "window rate, staleness, degraded count, active "
+                         "SLO alerts) from the fleet-merged collector "
+                         "view when available")
     args = ap.parse_args(argv)
+    if args.interval <= 0:
+        ap.error(f"--interval must be > 0 (got {args.interval}); "
+                 f"use --once or --count for bounded polling")
+    if args.once:
+        args.count = 1
 
     with HealthClient(args.address, token=args.token) as client:
         if args.command == "status":
@@ -139,9 +215,11 @@ def main(argv: Optional[list] = None) -> int:
             prev_windows: dict = {}
             while True:
                 if args.table:
-                    workers = worker_table(_fleet_rows(client), time.time())
+                    rows = _fleet_rows(client)
+                    workers = worker_table(rows, time.time())
                     print(_watch_table(workers, prev_windows,
-                                       args.interval if n else 0.0),
+                                       args.interval if n else 0.0,
+                                       fleet_alerts=_fleet_alerts(rows)),
                           flush=True)
                     prev_windows = {w: d.get("windows", 0)
                                     for w, d in workers.items()}

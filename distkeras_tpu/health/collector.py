@@ -147,8 +147,16 @@ def worker_table(rows: List[dict], now: float) -> Dict[str, dict]:
             w = entry(row.get("labels"))
             if w is not None:
                 w["degraded"] = w.get("degraded", 0) + row["value"]
+        elif kind == "gauge" and name == "health.alerts.active":
+            # per-worker-labelled SLO breaches land in that worker's row;
+            # fleet-wide alerts (no worker label) are the CLI's summary
+            # line, not a row
+            w = entry(row.get("labels"))
+            if w is not None and row.get("value"):
+                w["alerts"] = w.get("alerts", 0) + 1
     for w in workers.values():
         w.setdefault("degraded", 0)
+        w.setdefault("alerts", 0)
     return workers
 
 

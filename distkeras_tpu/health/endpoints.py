@@ -115,6 +115,18 @@ def handle_health_op(op: str, header: dict,
                if key.startswith("observability.hbm_")}
         if hbm:
             status["hbm"] = hbm
+        # SLO judgement (health/slo.py): active alerts of the installed
+        # engine ride the digest so `watch` and the CLI see breaches live.
+        # Lazy import keeps this module import-light (docstring contract).
+        from distkeras_tpu.health import slo as slo_mod
+
+        status["alerts"] = slo_mod.active_alerts()
+        rec = telemetry.get_recorder()
+        if rec is not None and hasattr(rec, "last_dump_path"):
+            status["recorder"] = {
+                "events": len(getattr(rec, "_ring", ())),
+                "last_dump": rec.last_dump_path,
+            }
         if extra_status:
             status.update(extra_status)
         return status

@@ -166,12 +166,16 @@ class Membership:
         m.evicted = True
         m.reason = reason
         telemetry.counter("elastic.evictions", reason=reason).inc()
+        telemetry.record_event("membership", transition="evict",
+                               worker=worker, reason=reason)
 
     def _readmit_locked(self, worker: int, m: _Member) -> None:
         m.evicted = False
         m.reason = ""
         m.expires = self._time() + m.lease_s
         telemetry.counter("elastic.readmissions").inc()
+        telemetry.record_event("membership", transition="readmit",
+                               worker=worker)
 
     # -- introspection ---------------------------------------------------
     def is_evicted(self, worker: int) -> bool:

@@ -62,6 +62,13 @@ class Stall(WatchdogError):
     kind = "stall"
 
 
+class SloBreach(WatchdogError):
+    """An SLO engine breach routed through the policy ladder
+    (``health/slo.watchdog_on_breach`` is the adapter)."""
+
+    kind = "slo"
+
+
 class TrainingWatchdog:
     """Monitors loss / update-norm streams; trips per the configured policy.
 
@@ -128,6 +135,15 @@ class TrainingWatchdog:
         telemetry.counter("health.watchdog.trips", kind=err.kind,
                           policy=self.policy).inc()
         telemetry.gauge("health.watchdog.tripped").set(1.0)
+        # forensics: the trip goes onto the flight-recorder ring, and (when
+        # a dump dir is configured — trainers bind the checkpoint dir) the
+        # whole ring is preserved as a postmortem bundle BEFORE any policy
+        # action can unwind the process
+        telemetry.record_event("watchdog_trip", kind=err.kind,
+                               policy=self.policy, message=str(err))
+        from distkeras_tpu.health import recorder
+
+        recorder.auto_dump(f"watchdog_{err.kind}")
         if self.policy == "warn":
             warnings.warn(f"watchdog [{err.kind}]: {err} "
                           f"(policy=warn, training continues)",
@@ -176,6 +192,18 @@ class TrainingWatchdog:
                 f"smoothed {source} {sm:.6g} exceeded "
                 f"{self.divergence_factor}x its best {best:.6g} "
                 f"after {n} observations"))
+
+    def observe_slo_breach(self, alert) -> None:
+        """Feed one SLO :class:`~distkeras_tpu.health.slo.AlertEvent` into
+        the policy ladder (the ``on_breach`` seam ROADMAP item 3's
+        canary/rollback attaches to): ``warn`` logs it, ``raise`` /
+        ``checkpoint_and_raise`` abort the run with a typed
+        :class:`SloBreach`. No-op after a trip, like every observation."""
+        if self.tripped is not None:
+            return
+        self._trip(SloBreach(
+            f"SLO {getattr(alert, 'slo', alert)!s} breached: "
+            f"{getattr(alert, 'message', '')}"))
 
     def observe_update_norm(self, value: float) -> None:
         """Feed one update (commit/delta) norm — NaN/Inf screened like a

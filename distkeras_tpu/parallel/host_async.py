@@ -36,6 +36,7 @@ import numpy as np
 
 from distkeras_tpu import comms, engine, telemetry
 from distkeras_tpu.data.prefetch import prefetch
+from distkeras_tpu.health import recorder as flight_recorder
 from distkeras_tpu.health.heartbeat import (HeartbeatPublisher,
                                             StragglerDetector)
 from distkeras_tpu.utils import fault
@@ -390,6 +391,14 @@ class HostAsyncRunner:
             except Exception as e:  # surface thread failures to the caller
                 if e not in errors:  # a watchdog on_trip may have filed it
                     errors.append(e)
+                # forensics: the failing worker's last windows are on the
+                # flight-recorder ring; preserve them before the run dies
+                telemetry.record_event(
+                    "worker_error", worker=worker_offset + k,
+                    error=type(e).__name__, message=str(e)[:200])
+                flight_recorder.auto_dump(
+                    "ps_unavailable" if isinstance(e, PSUnavailable)
+                    else "worker_exception")
                 abort.set()  # fail fast: siblings stop at their next round
                              # (the reference analogue: Spark killing the
                              # job when a task fails terminally)
@@ -490,7 +499,12 @@ class HostAsyncRunner:
                 break
             if abort.is_set():
                 return  # a sibling died: stop wasting windows
-            prof["data_wait"].record(time.perf_counter() - t_start)
+            # per-window phase breakdown, mirrored onto the flight-recorder
+            # ring as ONE structured event per window — the postmortem
+            # bundle's "trailing windows" evidence (histograms only keep
+            # aggregates; the ring keeps the last windows individually)
+            phases = {"data_wait": time.perf_counter() - t_start}
+            prof["data_wait"].record(phases["data_wait"])
             with _window_trace(self.trace, wid, fold):
                 t0 = time.perf_counter()
                 try:
@@ -504,9 +518,11 @@ class HostAsyncRunner:
                 t1 = time.perf_counter()
                 pull_h.record(t1 - t0)
                 prof["pull"].record(t1 - t0)
+                phases["pull"] = t1 - t0
                 center_dev = jax.device_put(center, dev)
                 t_h2d = time.perf_counter()
                 prof["h2d"].record(t_h2d - t1)
+                phases["h2d"] = t_h2d - t1
                 with telemetry.span("trace.compute", worker=wid):
                     carry, commit, ms = self.window_fn(
                         carry, center_dev, batches,
@@ -516,6 +532,7 @@ class HostAsyncRunner:
                 win_s = t2 - t1  # h2d + compute, as before the split
                 win_h.record(win_s)
                 prof["compute"].record(t2 - t_h2d)
+                phases["compute"] = t2 - t_h2d
                 to_send, last_up = commit, clock
                 if backlog is not None:
                     to_send = _tree_add(backlog, commit)
@@ -530,11 +547,20 @@ class HostAsyncRunner:
                         else:
                             clock_at_fold = ps.commit(to_send,
                                                       last_update=last_up)
-                except PSUnavailable:
+                except PSUnavailable as e:
                     degraded += 1
                     telemetry.counter("host_async.degraded_windows",
                                       worker=wid).inc()
+                    telemetry.record_event("degraded_window", worker=wid,
+                                           window=fold, degraded=degraded)
                     if degraded > self.max_degraded_windows:
+                        # ladder exhausted: this outage is terminal — put
+                        # the judgement next to the evidence before the
+                        # raise unwinds the worker
+                        telemetry.record_event(
+                            "ps_unavailable", worker=wid,
+                            degraded=degraded, message=str(e)[:200])
+                        flight_recorder.auto_dump("ps_unavailable")
                         raise
                     backlog, backlog_clock = to_send, last_up
                     deferred.append((clock, ms, win_s))
@@ -543,15 +569,22 @@ class HostAsyncRunner:
                     t3 = time.perf_counter()
                     commit_h.record(t3 - t2)
                     prof["commit"].record(t3 - t2)
+                    phases["commit"] = t3 - t2
                     degraded = 0
                     backlog = None
                     for d_clock, d_ms, d_win_s in deferred:
                         bookkeep(clock_at_fold, d_clock, d_ms, d_win_s)
                     deferred.clear()
                     bookkeep(clock_at_fold, clock, ms, win_s)
-                    prof["bookkeep"].record(time.perf_counter() - t3)
+                    phases["bookkeep"] = time.perf_counter() - t3
+                    prof["bookkeep"].record(phases["bookkeep"])
+            phases["window"] = time.perf_counter() - t_start
+            prof["window"].record(phases["window"])
+            telemetry.record_event(
+                "window_profile", worker=wid, window=fold,
+                degraded=degraded > 0,
+                phases={k: round(v, 6) for k, v in phases.items()})
             fold += 1
-            prof["window"].record(time.perf_counter() - t_start)
         if backlog is not None:
             # the run ended degraded: one last flush so the backlogged
             # windows are not silently dropped from the center/history

@@ -393,6 +393,8 @@ class ParameterServerService:
                 cached = self._dedup_get(cid, seq)
                 if cached is not None:
                     telemetry.counter("remote_ps.server.dedup_hits").inc()
+                    telemetry.record_event("wire", outcome="dedup_hit",
+                                           cid=cid, seq=seq)
                     self._reply(conn, op, cached)
                     return
             # decode ONCE into the leaves' native dtypes; the PS folds the
@@ -635,6 +637,8 @@ class RemoteParameterServer:
         with telemetry.span("trace.reconnect"):
             self._connect_locked()
         telemetry.counter("remote_ps.client.reconnects").inc()
+        telemetry.record_event("wire", outcome="reconnect",
+                               peer=f"{self._addr[0]}:{self._addr[1]}")
 
     def _connect_locked(self) -> None:
         sock = socket.create_connection(self._addr, timeout=self._timeout)
@@ -777,11 +781,16 @@ class RemoteParameterServer:
                 if attempt > self.retry.max_retries:
                     telemetry.counter("remote_ps.client.unavailable",
                                       op=op).inc()
+                    telemetry.record_event("wire", outcome="unavailable",
+                                           op=op, attempts=attempt,
+                                           error=str(e)[:200])
                     raise PSUnavailable(
                         f"parameter service {self._addr[0]}:"
                         f"{self._addr[1]} unavailable: {op} failed after "
                         f"{self.retry.max_retries} retries ({e})") from e
                 telemetry.counter("remote_ps.client.retries", op=op).inc()
+                telemetry.record_event("wire", outcome="retry", op=op,
+                                       attempt=attempt)
                 with telemetry.span("trace.retry", op=op, attempt=attempt):
                     time.sleep(self.retry.delay(attempt))
         # rtt includes the wait for the shared connection: the contention
@@ -853,11 +862,16 @@ class RemoteParameterServer:
                 if attempt > self.retry.max_retries:
                     telemetry.counter("remote_ps.client.unavailable",
                                       op=op).inc()
+                    telemetry.record_event("wire", outcome="unavailable",
+                                           op=op, attempts=attempt,
+                                           error=str(e)[:200])
                     raise PSUnavailable(
                         f"parameter service {self._addr[0]}:"
                         f"{self._addr[1]} unavailable: {op} failed after "
                         f"{self.retry.max_retries} retries ({e})") from e
                 telemetry.counter("remote_ps.client.retries", op=op).inc()
+                telemetry.record_event("wire", outcome="retry", op=op,
+                                       attempt=attempt)
                 time.sleep(self.retry.delay(attempt))
 
     # -- ParameterServer interface ----------------------------------------

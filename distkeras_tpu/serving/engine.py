@@ -336,6 +336,8 @@ class ServingEngine:
             # batcher. Don't leave submitters hanging forever — fail
             # whatever is still queued and make the timeout observable.
             telemetry.counter("serving.shutdown_timeouts").inc()
+            telemetry.record_event("serving", outcome="shutdown_timeout",
+                                   timeout_s=timeout)
             self._queue.fail_pending(EngineClosed(
                 f"batcher thread still running after {timeout}s "
                 f"shutdown join"))

@@ -353,6 +353,9 @@ class GenerationEngine:
                 raise EngineClosed("engine is shut down; no new requests")
             if len(self._dq) >= self.queue_capacity:
                 self._rejected_c.inc()
+                telemetry.record_event("serving", outcome="rejected",
+                                       depth=len(self._dq),
+                                       capacity=self.queue_capacity)
                 raise QueueFull(
                     f"generation queue at {len(self._dq)}/"
                     f"{self.queue_capacity}")
@@ -383,6 +386,9 @@ class GenerationEngine:
                     self._decode_step(active)
         except BaseException as e:  # scheduler must never die silently
             self._loop_err_c.inc()
+            telemetry.record_event("serving", outcome="loop_error",
+                                   error=type(e).__name__,
+                                   message=str(e)[:200])
             with self._cv:
                 self._closed = True
                 pending = list(self._dq)

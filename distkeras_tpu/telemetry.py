@@ -59,6 +59,8 @@ __all__ = [
     "METRIC_NAMES", "METRIC_PREFIXES", "declared_kind",
     "TraceContext", "current_trace", "use_trace", "inject", "extract",
     "record_trace_span", "flush_at_exit",
+    "set_recorder", "get_recorder", "record_event",
+    "set_process_index", "process_index", "per_process_path",
 ]
 
 SCHEMA_VERSION = 1
@@ -95,6 +97,9 @@ METRIC_NAMES = {
     "fault.chaos": "counter",
     "fault.injected": "counter",
     # health plane
+    "health.alerts.active": "gauge",
+    "health.alerts.breaches": "counter",
+    "health.alerts.evals": "counter",
     "health.straggler.events": "counter",
     "health.stragglers": "gauge",
     "health.watchdog.idle_s": "gauge",
@@ -183,6 +188,12 @@ METRIC_NAMES = {
     "serving.decode.ttft_s": "histogram",
     # trainer lifecycle
     "trainer.training_time_s": "gauge",
+    # flight recorder (health/recorder.py): bounded forensic ring + dumps
+    "recorder.dump_errors": "counter",
+    "recorder.dumps": "counter",
+    "recorder.events": "counter",
+    # artifact loading (load_jsonl crash-tail recovery accounting)
+    "telemetry.load.truncated_tail": "counter",
     # fleet telemetry collector (health/collector.py; lives on shard 0)
     "collector.batches": "counter",
     "collector.dropped_batches": "counter",
@@ -635,6 +646,9 @@ class MetricsRegistry:
     def record_span(self, name: str, t0: float, dur_s: float,
                     labels: Dict[str, Any]) -> None:
         self.spans.append((name, t0, dur_s, labels))
+        rec = _recorder
+        if rec is not None:  # flight-recorder ring (lock-light, bounded)
+            rec.record_span_event(name, t0, dur_s, labels)
         hist_labels = labels
         if labels and "trace_id" in labels:
             # trace ids are per-span unique: keeping them would mint one
@@ -725,6 +739,9 @@ def load_jsonl(path: str) -> List[dict]:
             if i == len(lines) - 1:
                 import warnings
 
+                # silent corruption becomes visible in fleet digests: the
+                # recovery is tolerated but COUNTED, not just warned about
+                counter("telemetry.load.truncated_tail").inc()
                 warnings.warn(
                     f"{path}: dropping truncated trailing line "
                     f"({line[:60]!r}...); returning the "
@@ -832,22 +849,88 @@ def record_trace_span(ctx: Optional["TraceContext"], name: str, t0: float,
     reg.record_span(name, t0, dur_s, labels)
 
 
+# -- flight-recorder sink (health/recorder.py plugs in here) -----------------
+#
+# The recorder is a plain object with ``record(kind, **fields)`` and
+# ``record_span_event(name, t0, dur_s, labels)`` methods; telemetry holds
+# only the slot so the dependency points health -> telemetry, never back.
+# The slot is module-global and read without a lock (same CPython-read
+# discipline as ``_installed``): the record paths stay lock-free.
+
+_recorder: Optional[Any] = None
+
+
+def set_recorder(rec: Optional[Any]) -> Optional[Any]:
+    """Install (or clear, with None) the process flight-recorder sink."""
+    global _recorder
+    _recorder = rec
+    return rec
+
+
+def get_recorder() -> Optional[Any]:
+    return _recorder
+
+
+def record_event(kind: str, /, **fields) -> None:
+    """Append one structured event to the flight-recorder ring (no-op when
+    no recorder is installed). Events are forensic breadcrumbs — wire
+    outcomes, membership transitions, window phase profiles, alerts — that
+    only leave the process inside a postmortem bundle."""
+    rec = _recorder
+    if rec is not None:
+        rec.record(kind, **fields)
+
+
+# -- per-process artifact identity -------------------------------------------
+#
+# telemetry/health must stay device-runtime-free, so the process index is
+# PUSHED in by the trainers (which know the real one) instead of read from
+# the accelerator runtime here. Default 0 = single-process runs unchanged.
+
+_process_index = 0
+
+
+def set_process_index(index: int) -> int:
+    """Declare this process's fleet index (trainers call this once the
+    runtime is up); stamps ``flush_at_exit`` artifacts and recorder dump
+    paths so shared-FS fleets cannot clobber each other."""
+    global _process_index
+    index = int(index)
+    if index < 0:
+        raise ValueError(f"process index must be >= 0, got {index}")
+    _process_index = index
+    return _process_index
+
+
+def process_index() -> int:
+    return _process_index
+
+
+def per_process_path(path: str) -> str:
+    """``path`` suffixed with this process's identity (``.p{index}``).
+    Merge tooling globs the family (``path.p*``)."""
+    return f"{path}.p{_process_index}"
+
+
 # -- crash-safe artifact flush ----------------------------------------------
 
 _flush_state: Dict[str, Optional[str]] = {"path": None}
 
 
 def flush_at_exit(path: str) -> str:
-    """Arrange for the installed registry to be dumped to ``path`` at
-    interpreter exit, so the span/metric artifact survives a crashed or
-    watchdog-killed run (``checkpoint_and_raise`` unwinds through here).
-    Idempotent: one atexit hook total, the most recent path wins. The hook
+    """Arrange for the installed registry to be dumped to
+    ``path.p{process_index}`` at interpreter exit, so the span/metric
+    artifact survives a crashed or watchdog-killed run
+    (``checkpoint_and_raise`` unwinds through here) and multi-process
+    fleets on a shared FS each keep their own copy. Idempotent: one atexit
+    hook total, the most recent path wins; the suffix is applied at FLUSH
+    time so a process index declared after this call still lands. The hook
     is a no-op when telemetry is uninstalled at exit time."""
     first = _flush_state["path"] is None
     _flush_state["path"] = str(path)
     if first:
         atexit.register(_flush_now)
-    return _flush_state["path"]
+    return per_process_path(_flush_state["path"])
 
 
 def _flush_now() -> Optional[str]:
@@ -855,6 +938,6 @@ def _flush_now() -> Optional[str]:
     if path is None or reg is None:
         return None
     try:
-        return reg.dump_jsonl(path)
+        return reg.dump_jsonl(per_process_path(path))
     except OSError:
         return None  # a dead disk at exit must not mask the real failure

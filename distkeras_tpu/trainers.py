@@ -154,6 +154,25 @@ class Trainer:
         # called distkeras_tpu.enable_compilation_cache(...) or exported
         # DISTKERAS_TPU_COMPILE_CACHE (see utils/jax_compat.py)
         jax_compat.enable_compilation_cache()
+        # flight-recorder wiring: the telemetry plane can't import jax, so
+        # the trainer pushes the process index down (multi-host artifact
+        # suffixes) and points the recorder's crash bundles at the same
+        # directory the crash checkpoint lands in
+        telemetry.set_process_index(jax.process_index())
+        from distkeras_tpu.health import recorder as flight_recorder
+        import os as _os
+
+        dump_dir = self.checkpoint_dir
+        if dump_dir is None and self.telemetry_path is not None:
+            dump_dir = _os.path.dirname(self.telemetry_path) or "."
+        flight_recorder.configure(
+            dump_dir=dump_dir,
+            trainer=type(self).__name__,
+            precision=self.precision,
+            worker_optimizer=str(self.worker_optimizer),
+            batch_size=self.batch_size,
+            codec=str(getattr(self, "codec", None)),
+            num_workers=getattr(self, "num_workers", 1))
         self._t0 = time.perf_counter()
 
     def _stop(self):
@@ -947,6 +966,11 @@ class DistributedTrainer(Trainer):
                         checkpoint_folds=folds, start_clock=start_clock,
                         watchdog=watchdog)
         except BaseException:
+            # postmortem bundle FIRST (ring + status + fingerprint, next to
+            # the crash checkpoint), then finalize in-flight snapshots
+            from distkeras_tpu.health import recorder as flight_recorder
+
+            flight_recorder.auto_dump("trainer_exception")
             if ckpt is not None:  # crash path: finalize in-flight snapshots
                 try:              # so resume sees the last completed one
                     ckpt.wait()

@@ -474,7 +474,9 @@ def test_flush_at_exit_writes_artifact(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     subprocess.run([sys.executable, "-c", code], check=True, env=env,
                    timeout=120, cwd=REPO)
-    rows = telemetry.load_jsonl(str(out))
+    # the flush suffixes the path with the process index (multi-host runs
+    # must not clobber one another's artifact): .p0 in a single process
+    rows = telemetry.load_jsonl(str(out) + ".p0")
     assert any(r.get("name") == "ps.commit.count" and r.get("value") == 3
                for r in rows)
     assert any(r.get("kind") == "span" and r.get("name") == "trace.window"
