@@ -42,6 +42,11 @@ FAULT_COUNTERS = (
     "elastic.evictions",
     "elastic.readmissions",
     "elastic.late_folds",
+    # coordinator-failover plane (DESIGN.md §17; zero on a clean run —
+    # failover_probe.py is the probe that makes them move)
+    "elastic.failover.kills",
+    "elastic.failover.promotions",
+    "elastic.failover.resolves",
 )
 
 

@@ -57,8 +57,12 @@ PROTOCOLS: Tuple[Protocol, ...] = (
         # so any op dict it grows (register/lease_renew/deregister/
         # shard_map fan-out) is checked against the server dispatch
         server_paths=("distkeras_tpu/parallel/remote_ps.py",),
+        # failover.py is the replication/lease client of the standby's
+        # service (repl_append / coord_lease); its ops are part of this
+        # protocol's surface
         client_paths=("distkeras_tpu/parallel/remote_ps.py",
                       "distkeras_tpu/parallel/elastic.py",
+                      "distkeras_tpu/parallel/failover.py",
                       "distkeras_tpu/health/endpoints.py"),
     ),
     Protocol(
@@ -67,15 +71,16 @@ PROTOCOLS: Tuple[Protocol, ...] = (
         client_paths=("distkeras_tpu/serving/server.py",
                       "distkeras_tpu/health/endpoints.py"),
         # HealthClient is shared across every server; the fleet-telemetry
-        # merge op is mounted only on the PS coordinator (remote_ps), and
+        # merge op and the coordinator-discovery op it uses to follow a
+        # failover are mounted only on the PS services (remote_ps), and
         # the CLI catches the clean "unknown op" error and falls back
-        client_only=("telemetry_merged",),
+        client_only=("telemetry_merged", "coordinator"),
     ),
     Protocol(
         name="health",
         server_paths=("distkeras_tpu/health/endpoints.py",),
         client_paths=("distkeras_tpu/health/endpoints.py",),
-        client_only=("telemetry_merged",),
+        client_only=("telemetry_merged", "coordinator"),
     ),
 )
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import os
-from typing import Any, Optional
+from typing import Any, Optional, Sequence
 
 import jax
 import numpy as np
@@ -29,11 +29,22 @@ class Checkpointer:
         ckpt = Checkpointer(dir, max_to_keep=3)
         ckpt.save(step, state)           # state: TrainState or params pytree
         state = ckpt.restore(like=state) # latest, or step=N for a specific one
+
+    ``items=`` switches a directory to MULTI-ITEM steps (Orbax composite
+    layout): ``save`` then takes a dict keyed by item name and ``restore``
+    can read a SUBSET of items (``items=("state",)``) without touching the
+    others' array data — the elastic-resume win (DESIGN.md §6): a
+    topology-change resume reads the small ``state`` item and never drags
+    the stale ``carries`` item into host RAM. Old single-item steps in the
+    same directory stay readable through :meth:`restore_legacy`; probe a
+    step's actual layout with :meth:`step_items`.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 local_host_only: bool = False):
+                 local_host_only: bool = False,
+                 items: Optional[Sequence[str]] = None):
         self.directory = os.path.abspath(directory)
+        self.items = tuple(items) if items is not None else None
         os.makedirs(self.directory, exist_ok=True)
         kwargs = dict(max_to_keep=max_to_keep, create=True)
         if local_host_only:
@@ -50,14 +61,24 @@ class Checkpointer:
             # create=True is unsupported with active_processes; the
             # makedirs above already created the root
             kwargs["create"] = False
+        self._opt_kwargs = dict(kwargs)
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(**kwargs),
-            # declare the handler up front: metadata() must be able to read
-            # a step's shapes in a FRESH manager that has neither saved nor
-            # restored yet (elastic-resume topology probe)
-            item_handlers=ocp.StandardCheckpointHandler(),
+            # declare the handler(s) up front: metadata() must be able to
+            # read a step's shapes in a FRESH manager that has neither
+            # saved nor restored yet (elastic-resume topology probe)
+            item_names=self.items,
+            item_handlers=(ocp.StandardCheckpointHandler()
+                           if self.items is None else
+                           {name: ocp.StandardCheckpointHandler()
+                            for name in self.items}),
         )
+        # lazy second manager over the SAME directory with the historical
+        # single-item layout: a composite manager asked about a legacy
+        # step warns and reports a phantom 'default' item, so legacy steps
+        # are read through this one (see restore_legacy / step_items)
+        self._legacy: Optional[ocp.CheckpointManager] = None
         # Orbax's CheckpointManager is NOT thread-safe: only the thread
         # that dispatched a save may reset its finalize bookkeeping, so
         # saves from two threads (the host_async cadence saver vs the
@@ -72,45 +93,115 @@ class Checkpointer:
         def _dispatch():
             # previous async save's finalize must drain before a new save
             self._mgr.wait_until_finished()
-            self._mgr.save(int(step), args=ocp.args.StandardSave(state))
+            if self.items is None:
+                args = ocp.args.StandardSave(state)
+            else:
+                unknown = sorted(set(state) - set(self.items))
+                if unknown:
+                    raise ValueError(
+                        f"save() got items {unknown} not declared at "
+                        f"construction (items={self.items})")
+                args = ocp.args.Composite(**{
+                    name: ocp.args.StandardSave(sub)
+                    for name, sub in state.items()})
+            self._mgr.save(int(step), args=args)
 
         self._exec.submit(_dispatch).result()
         if wait:
             self._mgr.wait_until_finished()
 
+    def _resolve_step(self, step: Optional[int]) -> int:
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"No checkpoint found under {self.directory}")
+        return int(step)
+
+    @staticmethod
+    def _abstract(like: Any, host: bool) -> Any:
+        return like if host else jax.tree.map(
+            ocp.utils.to_shape_dtype_struct, like)
+
     def restore(self, like: Any, step: Optional[int] = None,
-                host: bool = False) -> Any:
+                host: bool = False,
+                items: Optional[Sequence[str]] = None) -> Any:
         """Restore the given (or latest) step into the structure of ``like``.
 
         ``host=True`` restores into HOST numpy arrays (``like`` leaves must
         be numpy): no sharding is attached or looked up from the
         checkpoint's sharding file — required when restoring a checkpoint
         written on a device topology that no longer exists (elastic
-        resume), where the recorded shardings reference dead devices."""
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise FileNotFoundError(
-                    f"No checkpoint found under {self.directory}")
-        abstract = like if host else jax.tree.map(
-            ocp.utils.to_shape_dtype_struct, like)
-        return self._mgr.restore(int(step),
-                                 args=ocp.args.StandardRestore(abstract))
+        resume), where the recorded shardings reference dead devices.
+
+        Multi-item mode: ``like`` is a dict keyed by item name; ``items=``
+        selects which of them to actually read (default: every item named
+        in ``like``) — unselected items cost no I/O and no host RAM.
+        Returns a dict holding only the restored items."""
+        step = self._resolve_step(step)
+        if self.items is None:
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(
+                    self._abstract(like, host)))
+        names = tuple(items) if items is not None else tuple(
+            k for k in self.items if k in like)
+        out = self._mgr.restore(step, args=ocp.args.Composite(**{
+            name: ocp.args.StandardRestore(
+                self._abstract(like[name], host)) for name in names}))
+        return {name: out[name] for name in names}
+
+    def restore_legacy(self, like: Any, step: Optional[int] = None,
+                       host: bool = False) -> Any:
+        """Read a pre-multi-item (single ``default`` item) step from a
+        directory that has since switched to ``items=`` mode — the
+        resume-compatibility path for checkpoints written by older
+        trainers. No-op difference from :meth:`restore` when this
+        checkpointer is itself single-item."""
+        if self.items is None:
+            return self.restore(like, step=step, host=host)
+        return self._legacy_mgr().restore(
+            self._resolve_step(step),
+            args=ocp.args.StandardRestore(self._abstract(like, host)))
+
+    def _legacy_mgr(self) -> ocp.CheckpointManager:
+        if self._legacy is None:
+            self._legacy = ocp.CheckpointManager(
+                self.directory,
+                options=ocp.CheckpointManagerOptions(
+                    **dict(self._opt_kwargs, create=False)),
+                item_handlers=ocp.StandardCheckpointHandler(),
+            )
+        return self._legacy
+
+    def step_items(self, step: Optional[int] = None) -> list:
+        """The item names a saved step ACTUALLY holds, read from the step
+        directory itself: legacy single-item steps report ``['default']``,
+        multi-item steps their item names. This — not ``item_metadata``,
+        which answers for the manager's configured layout rather than the
+        step's — is how a resume decides between :meth:`restore` and
+        :meth:`restore_legacy` when a directory spans the format change."""
+        step = self._resolve_step(step)
+        d = os.path.join(self.directory, str(step))
+        return sorted(n for n in os.listdir(d) if not n.startswith("_"))
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
-    def metadata(self, step: Optional[int] = None):
+    def metadata(self, step: Optional[int] = None,
+                 item: Optional[str] = None):
         """Shapes/dtypes of a saved step WITHOUT reading array data — the
         topology probe for elastic resume (a trainer can learn the worker
         count a checkpoint was written with before committing to a
-        full-shape restore)."""
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise FileNotFoundError(
-                    f"No checkpoint found under {self.directory}")
-        meta = self._mgr.item_metadata(int(step))
+        full-shape restore). Multi-item mode: pass ``item=`` for one
+        item's tree; legacy steps are routed to the legacy reader."""
+        step = self._resolve_step(step)
+        if self.items is not None and "default" in self.step_items(step):
+            meta = self._legacy_mgr().item_metadata(step)
+            return getattr(meta, "tree", meta)
+        meta = self._mgr.item_metadata(step)
+        if item is not None:
+            meta = meta[item] if hasattr(meta, "__getitem__") \
+                else getattr(meta, item)
         return getattr(meta, "tree", meta)
 
     def clear(self) -> None:
@@ -136,6 +227,8 @@ class Checkpointer:
     def close(self) -> None:
         self._exec.shutdown(wait=True)
         self._mgr.close()
+        if self._legacy is not None:
+            self._legacy.close()
 
 
 def save_params(path: str, params) -> None:

@@ -214,17 +214,28 @@ def main(argv: Optional[list] = None) -> int:
             n = 0
             prev_windows: dict = {}
             while True:
-                if args.table:
-                    rows = _fleet_rows(client)
-                    workers = worker_table(rows, time.time())
-                    print(_watch_table(workers, prev_windows,
-                                       args.interval if n else 0.0,
-                                       fleet_alerts=_fleet_alerts(rows)),
+                # a dead poll is not the end of the watch: HealthClient
+                # already tried to re-resolve a moved coordinator
+                # (DESIGN.md §17); when even that fails (e.g. the standby's
+                # lease has not lapsed yet) keep polling — the next tick
+                # lands after promotion
+                try:
+                    if args.table:
+                        rows = _fleet_rows(client)
+                        workers = worker_table(rows, time.time())
+                        print(_watch_table(
+                            workers, prev_windows,
+                            args.interval if n else 0.0,
+                            fleet_alerts=_fleet_alerts(rows)),
+                            flush=True)
+                        prev_windows = {w: d.get("windows", 0)
+                                        for w, d in workers.items()}
+                    else:
+                        print(_watch_line(client.status()), flush=True)
+                except (OSError, RuntimeError) as e:
+                    print(f"[watch] {client.address} unreachable "
+                          f"({type(e).__name__}: {e}); retrying",
                           flush=True)
-                    prev_windows = {w: d.get("windows", 0)
-                                    for w, d in workers.items()}
-                else:
-                    print(_watch_line(client.status()), flush=True)
                 n += 1
                 if args.count and n >= args.count:
                     break

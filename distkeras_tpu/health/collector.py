@@ -83,6 +83,19 @@ class TelemetryCollector:
         telemetry.gauge("collector.processes").set(processes)
         return {"accepted": len(rows), "dropped": dropped}
 
+    def adopt_batches(self, batches: List[Tuple[int, List[dict]]]) -> int:
+        """Seed a freshly-mounted collector from a replicated mirror —
+        the promotion half of coordinator failover (parallel/failover.py):
+        the standby's :class:`StandbyState` mirrors every
+        ``telemetry_put`` batch the old coordinator absorbed, and the
+        collector that re-mounts on the NEW coordinator starts from that
+        mirror instead of empty. Same bounds/counters as live pushes.
+        Returns the number of rows adopted."""
+        total = 0
+        for pid, rows in batches:
+            total += self.add_batch(pid, rows)["accepted"]
+        return total
+
     def merged_rows(self, local_pid: Optional[int] = None) -> List[dict]:
         """Every buffered row, each tagged with its origin ``pid``. When
         ``local_pid`` is given, the hosting process's OWN live registry is

@@ -139,33 +139,108 @@ class HealthClient:
     One persistent control connection, header-only requests; ``token``
     must match the service's shared secret. The wire helpers are imported
     lazily so importing this module never pulls in ``remote_ps`` (which
-    itself imports this module to mount the ops)."""
+    itself imports this module to mount the ops).
+
+    ``follow=True`` (default) makes the client survive a coordinator MOVE
+    (DESIGN.md §17): status replies advertise the fleet's shard + standby
+    addresses, and when the watched service dies or answers "fenced", the
+    client asks an advertised peer ``{"op": "coordinator"}`` — the same
+    discovery op whose lease check triggers lazy standby promotion — and
+    re-attaches to the promoted coordinator instead of erroring out."""
 
     def __init__(self, address: str, token: Optional[str] = None,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, follow: bool = True):
         from distkeras_tpu.parallel.remote_ps import (recv_message,
                                                       send_message)
 
         self._send, self._recv = send_message, recv_message
-        host, _, port = address.rpartition(":")
         self.address = address
         self.token = token
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.timeout = timeout
+        self.follow = bool(follow)
+        self._alternates: List[str] = []
+        self._sock = self._connect(address)
 
-    def _call(self, op: str, **fields) -> dict:
+    def _connect(self, address: str) -> socket.socket:
+        host, _, port = address.rpartition(":")
+        sock = socket.create_connection((host, int(port)),
+                                        timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def _note_hints(self, reply: dict) -> None:
+        # remember every address the service advertises (shard fleet +
+        # standby) — the candidate list for coordinator re-resolution
+        hints = list(reply.get("shard_addresses") or [])
+        if reply.get("standby"):
+            hints.append(reply["standby"])
+        for addr in hints:
+            if addr and addr != self.address \
+                    and addr not in self._alternates:
+                self._alternates.append(addr)
+
+    def _call_once(self, op: str, fields: dict) -> dict:
         header: Dict[str, Any] = {"op": op, **fields}
         if self.token is not None:
             header["token"] = self.token
         self._send(self._sock, header)
         reply, _ = self._recv(self._sock)
         if "error" in reply:
+            if reply.get("error_kind") == "fenced" and self.follow and \
+                    self._re_resolve(prefer=reply.get("coordinator")):
+                return self._call_once(op, fields)
             raise RuntimeError(
                 f"health op {op!r} against {self.address}: "
                 f"{reply['error']}")
+        self._note_hints(reply)
         reply.pop("blob_lens", None)
         return reply
+
+    def _call(self, op: str, **fields) -> dict:
+        try:
+            return self._call_once(op, fields)
+        except OSError:
+            if not self.follow or not self._re_resolve():
+                raise
+            return self._call_once(op, fields)
+
+    def _re_resolve(self, prefer: Optional[str] = None) -> bool:
+        """Find the live coordinator among the advertised peers and point
+        this client at it. Returns False when no candidate answers with a
+        live (possibly just-promoted) coordinator — e.g. the standby's
+        lease window has not lapsed yet; the caller may simply retry."""
+        candidates = ([prefer] if prefer else []) + list(self._alternates)
+        for addr in candidates:
+            try:
+                sock = self._connect(addr)
+            except OSError:
+                continue
+            try:
+                header: Dict[str, Any] = {"op": "coordinator"}
+                if self.token is not None:
+                    header["token"] = self.token
+                self._send(sock, header)
+                view, _ = self._recv(sock)
+            except OSError:
+                sock.close()
+                continue
+            if "error" in view:
+                sock.close()
+                continue
+            target = view.get("address") or addr
+            if target == addr:
+                new_sock = sock  # the probe already holds the coordinator
+            else:
+                sock.close()
+                try:
+                    new_sock = self._connect(target)
+                except OSError:
+                    continue
+            self.close()
+            self._sock, self.address = new_sock, target
+            telemetry.counter("elastic.failover.resolves").inc()
+            return True
+        return False
 
     def status(self) -> dict:
         return self._call("status")

@@ -177,6 +177,44 @@ class Membership:
         telemetry.record_event("membership", transition="readmit",
                                worker=worker)
 
+    # -- replication (coordinator failover, parallel/failover.py) --------
+    def export(self) -> dict:
+        """The member table as a plain-JSON snapshot for the write-behind
+        log. Lease deadlines travel as REMAINING seconds (``expires_in``),
+        not absolute times: the standby's clock need not agree with the
+        coordinator's, only tick at the same rate."""
+        now = self._time()
+        with self._lock:
+            return {
+                str(w): {
+                    "lease_s": m.lease_s,
+                    "expires_in": round(m.expires - now, 3),
+                    "evicted": m.evicted,
+                    "reason": m.reason,
+                    "commits": m.commits,
+                } for w, m in self._members.items()
+            }
+
+    def restore(self, table: dict) -> None:
+        """Rebuild the member table from an :meth:`export` snapshot — the
+        promotion half of coordinator failover. Replaces any existing
+        members; lease deadlines re-anchor on THIS table's clock. Members
+        whose remaining lease was already negative at export time come
+        back expired and are evicted by the next sweep (they then re-admit
+        through the normal late-fold path when they return)."""
+        now = self._time()
+        with self._lock:
+            self._members.clear()
+            for worker, row in table.items():
+                m = _Member(float(row.get("lease_s", self.lease_s)), now)
+                m.expires = now + float(row.get("expires_in", m.lease_s))
+                m.evicted = bool(row.get("evicted", False))
+                m.reason = str(row.get("reason", ""))
+                m.commits = int(row.get("commits", 0))
+                self._members[int(worker)] = m
+            n = len(self._members)
+        telemetry.gauge("elastic.workers").set(n)
+
     # -- introspection ---------------------------------------------------
     def is_evicted(self, worker: int) -> bool:
         with self._lock:

@@ -33,6 +33,7 @@ barrier, final center).
 from __future__ import annotations
 
 import concurrent.futures
+import threading
 import time
 from typing import Any, Callable, Optional, Sequence
 
@@ -42,11 +43,48 @@ import numpy as np
 from distkeras_tpu import telemetry
 from distkeras_tpu.health.heartbeat import StragglerDetector
 from distkeras_tpu.health.membership import DEFAULT_LEASE_S, Membership
+from distkeras_tpu.parallel import failover
 from distkeras_tpu.parallel.remote_ps import (
+    CoordinatorFenced,
     ParameterServerService,
+    PSUnavailable,
     RemoteParameterServer,
 )
 from distkeras_tpu.utils.fetch import device_get_batched
+
+#: shard→process placement policies (DESIGN.md §17): "process0" is the
+#: historical layout (every shard on process 0's host — fan-out buys
+#: socket/codec/fold parallelism, not NIC aggregation); "spread" deals
+#: shards round-robin over processes so the fleet aggregates NICs and
+#: survives single-host loss.
+PLACEMENT_POLICIES = ("process0", "spread")
+
+
+def shard_placement(num_shards: int, num_processes: int,
+                    policy: str = "process0") -> list:
+    """Deterministic shard→hosting-process map; every process computes
+    the identical map from the same (num_shards, num_processes, policy),
+    so the map itself never travels — only the resulting addresses do.
+    "spread" degenerates to all-on-0 at one process."""
+    if policy not in PLACEMENT_POLICIES:
+        raise ValueError(f"ps_placement must be one of "
+                         f"{PLACEMENT_POLICIES}, got {policy!r}")
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    if policy == "process0" or int(num_processes) <= 1:
+        return [0] * num_shards
+    return [s % int(num_processes) for s in range(num_shards)]
+
+
+def standby_process(placement: Sequence[int]) -> int:
+    """Which process hosts the coordinator's standby: shard 1's process —
+    a DIFFERENT host than the coordinator whenever the placement spreads
+    over >1 process, so the standby survives the coordinator's host
+    dying. Single-shard (or process0) fleets fall back to the
+    coordinator's own process: the standby then still survives service
+    death, just not host death."""
+    placement = list(placement)
+    return placement[1] if len(placement) > 1 else placement[0]
 
 
 def shard_assignment(like: Any, num_shards: int) -> list:
@@ -97,30 +135,133 @@ def make_ps_fleet(ps_factory: Callable[[Any], Any], like: Any,
                   advertise_host: str = "127.0.0.1",
                   lease_s: float = DEFAULT_LEASE_S,
                   straggler: Optional[StragglerDetector] = None,
-                  time_fn: Callable[[], float] = time.time) -> list:
-    """Construct and start N shard services on this host.
+                  time_fn: Callable[[], float] = time.time,
+                  local_shards: Optional[Sequence[int]] = None,
+                  standby: bool = False,
+                  coord_lease_s: float = failover.DEFAULT_COORD_LEASE_S,
+                  start: bool = True) -> list:
+    """Construct (and by default wire + start) shard services on this host.
 
     ``ps_factory`` builds the server flavor for one shard's leaf list
     (e.g. ``DynSGDParameterServer``). Shard 0 gets the membership plane
     (leases + straggler-driven eviction); followers hold only leaves.
-    Every service is started and knows the full fleet map
-    (``shard_addresses``), so any shard can bootstrap a late joiner.
+
+    ``local_shards`` selects WHICH shards this process hosts (None = all
+    of them — the historical single-host fleet). With a partial set the
+    services come back bound-but-unstarted regardless of ``start``: the
+    launcher must gather the cross-host address map first and finish via
+    :func:`connect_fleet` (see ``run_cross_process``'s spread placement).
+
+    ``standby=True`` appends a DARK standby service (LAST in the returned
+    list, so ``services[0]`` stays the coordinator when it is local and
+    blanket ``stop()`` loops keep working): a full service over a
+    shard-0 replica built by the same factory, serving only the
+    replication/discovery/health plane until its
+    :class:`~distkeras_tpu.parallel.failover.StandbyState` promotes.
     """
     assignment = shard_assignment(like, num_shards)
     parts = split_tree(like, assignment)
+    which = list(range(num_shards)) if local_shards is None \
+        else sorted(int(s) for s in local_shards)
     services = []
-    for shard, part in enumerate(parts):
+    for shard in which:
+        part = parts[shard]
         membership = Membership(lease_s=lease_s, straggler=straggler,
                                 time_fn=time_fn) if shard == 0 else None
-        services.append(ParameterServerService(
+        svc = ParameterServerService(
             ps_factory(part), part, expected_processes=expected_processes,
             host=host, token=token, codecs=codecs, membership=membership,
-            shard=shard, num_shards=num_shards))
-    addresses = [f"{advertise_host}:{svc.port}" for svc in services]
+            shard=shard, num_shards=num_shards)
+        svc.advertised = f"{advertise_host}:{svc.port}"
+        services.append(svc)
+    if standby:
+        # the standby replicates the COORDINATOR: same shard-0 leaf
+        # subset, same server flavor (same start clock via the factory),
+        # so replayed folds land on a bit-identical replica
+        svc = ParameterServerService(
+            ps_factory(parts[0]), parts[0],
+            expected_processes=expected_processes, host=host,
+            token=token, codecs=codecs, membership=None, shard=0,
+            num_shards=num_shards)
+        svc.advertised = f"{advertise_host}:{svc.port}"
+        svc.is_standby = True
+        svc.standby = failover.StandbyState(
+            svc, lease_s=coord_lease_s, member_lease_s=lease_s,
+            straggler=straggler, time_fn=time_fn)
+        services.append(svc)
+    if start and local_shards is None:
+        addresses = [svc.advertised for svc in services
+                     if not svc.is_standby]
+        standby_addr = next((svc.advertised for svc in services
+                             if svc.is_standby), None)
+        connect_fleet(services, addresses, standby_address=standby_addr,
+                      token=token, coord_lease_s=coord_lease_s,
+                      time_fn=time_fn)
+    return services
+
+
+def connect_fleet(services: Sequence, addresses: Sequence[str],
+                  standby_address: Optional[str] = None, *,
+                  token: Optional[str] = None,
+                  coord_lease_s: float = failover.DEFAULT_COORD_LEASE_S,
+                  time_fn: Callable[[], float] = time.time) -> None:
+    """Wire this process's (possibly partial) services into one fleet and
+    start them: every service learns the full shard map + standby
+    address, and a locally-hosted coordinator gets its
+    :class:`~distkeras_tpu.parallel.failover.Replicator` streaming
+    clock/membership/commits to the standby."""
+    addresses = list(addresses)
     for svc in services:
         svc.shard_addresses = addresses
+        svc.standby_address = standby_address
         svc.start()
-    return services
+    if standby_address is None:
+        return
+    for svc in services:
+        if svc.shard == 0 and not svc.is_standby:
+            rep = failover.Replicator(
+                standby_address, token=token, lease_s=coord_lease_s,
+                members_fn=(svc.membership.export
+                            if svc.membership is not None else None),
+                clock_fn=lambda s=svc: int(s.ps.num_updates),
+                on_fenced=lambda epoch, s=svc: s.fence(epoch),
+                time_fn=time_fn)
+            svc.replicator = rep
+            rep.start()
+
+
+def gather_fleet_addresses(services: Sequence, num_shards: int) -> tuple:
+    """All-gather every process's locally-hosted shard addresses into the
+    complete fleet map. Returns ``(addresses, standby_address)`` —
+    identical on every process. Single-process: a pure local reshuffle,
+    no collective."""
+    local = {("standby" if svc.is_standby else int(svc.shard)):
+             svc.advertised for svc in services}
+    if jax.process_count() == 1:
+        return ([local[s] for s in range(num_shards)],
+                local.get("standby"))
+    from jax.experimental import multihost_utils
+    msg = ";".join(f"{k}={v}" for k, v in sorted(
+        local.items(), key=lambda kv: str(kv[0])))
+    payload = np.zeros((512,), np.uint8)
+    raw = msg.encode()
+    if len(raw) > payload.size:
+        raise ValueError(f"address payload {len(raw)}B exceeds "
+                         f"{payload.size}B broadcast slot")
+    payload[:len(raw)] = np.frombuffer(raw, np.uint8)
+    gathered = np.asarray(multihost_utils.process_allgather(payload))
+    merged: dict = {}
+    for row in gathered:
+        text = bytes(np.asarray(row)[np.asarray(row) != 0]).decode()
+        for entry in filter(None, text.split(";")):
+            key, _, addr = entry.partition("=")
+            merged[key] = addr
+    missing = [s for s in range(num_shards) if str(s) not in merged]
+    if missing:
+        raise RuntimeError(f"fleet address gather incomplete: shards "
+                           f"{missing} unhosted (map: {merged})")
+    return ([merged[str(s)] for s in range(num_shards)],
+            merged.get("standby"))
 
 
 class ShardedRemoteParameterServer:
@@ -141,7 +282,8 @@ class ShardedRemoteParameterServer:
     def __init__(self, addresses: Sequence[str], like: Any,
                  timeout: float = 600.0, token: Optional[str] = None,
                  codec: str = "raw", retry=None,
-                 op_timeout: Optional[float] = None):
+                 op_timeout: Optional[float] = None,
+                 standby: Optional[str] = None):
         addresses = list(addresses)
         if not addresses:
             raise ValueError("need at least one shard address")
@@ -159,14 +301,81 @@ class ShardedRemoteParameterServer:
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(4, 2 * len(self.clients)),
             thread_name_prefix="ps-shard")
+        # coordinator failover (DESIGN.md §17): the standby's address and
+        # everything needed to rebuild the coordinator leg against it
+        self.standby_address = standby
+        self.coord_epoch = 0
+        self._parts = parts
+        self._client_kw = dict(timeout=timeout, token=token, codec=codec,
+                               retry=retry, op_timeout=op_timeout)
+        self._failover_lock = threading.Lock()
 
     @property
     def coordinator(self) -> RemoteParameterServer:
         return self.clients[0]
 
+    # -- coordinator re-resolution (DESIGN.md §17) -------------------------
+    def _coord_call(self, fn):
+        """Run a coordinator-leg operation; on a dead or fenced
+        coordinator, re-resolve through the standby and retry ONCE. The
+        original typed error propagates when re-resolution fails (or no
+        standby is configured) — the host_async degradation ladder then
+        takes over exactly as before failover existed."""
+        client = self.clients[0]
+        try:
+            return fn(client)
+        except (PSUnavailable, CoordinatorFenced) as e:
+            if not self._re_resolve(client, e):
+                raise
+        return fn(self.clients[0])
+
+    def _re_resolve(self, failed, err) -> bool:
+        if self.standby_address is None:
+            return False
+        with self._failover_lock:
+            if self.clients[0] is not failed:
+                return True  # another thread already swapped the leg
+            # a fenced reply names the promoted coordinator outright;
+            # otherwise ask the standby (whose lease check IS the
+            # failure detector — it promotes while answering)
+            addr = getattr(err, "coordinator", None) or \
+                self.standby_address
+            fresh = None
+            try:
+                fresh = RemoteParameterServer(addr, self._parts[0],
+                                              **self._client_kw)
+                view = fresh.coordinator_view()
+            except (PSUnavailable, RuntimeError, OSError):
+                if fresh is not None:
+                    fresh.close()
+                return False
+            if not view.get("promoted") or \
+                    int(view.get("epoch", 0)) <= self.coord_epoch:
+                # the lease has not lapsed yet (coordinator slow, not
+                # dead) — keep degrading; a later window retries here
+                fresh.close()
+                return False
+            old = self.clients[0]
+            # commit identity continuity: the promoted coordinator's
+            # replicated dedup mirror is keyed by the ORIGINAL (cid, seq)
+            # stream, so the new leg keeps both
+            fresh.cid = old.cid
+            with old._seq_lock:
+                fresh._seq = old._seq
+            self.clients[0] = fresh
+            self.coord_epoch = int(view["epoch"])
+            old.close()
+            telemetry.counter("elastic.failover.resolves").inc()
+            telemetry.record_event("failover", transition="re_resolve",
+                                   address=view.get("address", addr),
+                                   epoch=self.coord_epoch)
+            return True
+
     # -- ParameterServer interface ----------------------------------------
     def pull(self):
-        futures = [self._pool.submit(c.pull) for c in self.clients]
+        futures = [self._pool.submit(self._coord_call,
+                                     lambda c: c.pull())]
+        futures += [self._pool.submit(c.pull) for c in self.clients[1:]]
         results = [f.result() for f in futures]
         # clock authority is the coordinator; follower clocks only order
         # their own folds (see the torn-read note in the module docstring)
@@ -191,9 +400,10 @@ class ShardedRemoteParameterServer:
         # runs the membership plane — late folds, lease renewal); every
         # follower then folds the same commit at that explicit weight
         with telemetry.span("trace.shard", shard=0):
-            at_fold, applied = self.clients[0].commit_ex(
-                parts[0], last_update=last_update, weight=weight, seq=seq,
-                worker=worker, window_s=window_s)
+            at_fold, applied = self._coord_call(
+                lambda c: c.commit_ex(
+                    parts[0], last_update=last_update, weight=weight,
+                    seq=seq, worker=worker, window_s=window_s))
         futures = [
             self._pool.submit(self._shard_leg, ctx, i, c, part,
                               last_update, applied, seq)
@@ -211,37 +421,43 @@ class ShardedRemoteParameterServer:
 
     @property
     def num_updates(self) -> int:
-        return self.clients[0].num_updates
+        return self._coord_call(lambda c: c.num_updates)
 
     # membership/history live on the coordinator shard
     def register(self, worker: int,
                  lease_s: Optional[float] = None) -> float:
-        return self.clients[0].register(worker, lease_s=lease_s)
+        return self._coord_call(
+            lambda c: c.register(worker, lease_s=lease_s))
 
     def renew_lease(self, worker: int) -> bool:
-        return self.clients[0].renew_lease(worker)
+        return self._coord_call(lambda c: c.renew_lease(worker))
 
     def deregister(self, worker: int) -> None:
-        self.clients[0].deregister(worker)
+        self._coord_call(lambda c: c.deregister(worker))
 
     def shard_map(self) -> dict:
-        return self.clients[0].shard_map()
+        return self._coord_call(lambda c: c.shard_map())
+
+    def coordinator_view(self) -> dict:
+        return self._coord_call(lambda c: c.coordinator_view())
 
     def put_history(self, pid: int, windows: list) -> None:
-        self.clients[0].put_history(pid, windows)
+        self._coord_call(lambda c: c.put_history(pid, windows))
 
-    # the telemetry collector also lives on the coordinator shard
+    # the telemetry collector also lives on the coordinator shard (and
+    # follows it through a promotion — the standby re-mounts one)
     def put_telemetry(self, pid: int, rows: list) -> dict:
-        return self.clients[0].put_telemetry(pid, rows)
+        return self._coord_call(lambda c: c.put_telemetry(pid, rows))
 
     def get_merged_telemetry(self) -> list:
-        return self.clients[0].get_merged_telemetry()
+        return self._coord_call(lambda c: c.get_merged_telemetry())
 
     def get_history(self, timeout: float = 600):
         # the barrier (and merged history, and final clock) live on the
         # coordinator; the fleet is quiescent once it resolves, so the
         # follower pulls below read a settled center
-        windows, part0, clock = self.clients[0].get_history(timeout=timeout)
+        windows, part0, clock = self._coord_call(
+            lambda c: c.get_history(timeout=timeout))
         futures = [self._pool.submit(c.pull) for c in self.clients[1:]]
         parts = [part0] + [f.result()[0] for f in futures]
         return (windows, join_tree(parts, self.assignment, self._treedef),

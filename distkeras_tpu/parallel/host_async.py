@@ -698,7 +698,9 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
                       checkpoint_folds: int = 0, start_clock: int = 0,
                       service_port: int = 0,
                       history_timeout: float = 600.0,
-                      watchdog=None, ps_shards: int = 1) -> tuple:
+                      watchdog=None, ps_shards: int = 1,
+                      ps_placement: str = "process0",
+                      ps_standby: bool = False) -> tuple:
     """Pod-scale TRUE-async: this process's worker threads against ONE live
     center owned by process 0 (VERDICT r4 ask #2 — the reference's
     workers-on-separate-machines semantics).
@@ -726,6 +728,21 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
     up the no-loopback-tax direct path — go through a
     ShardedRemoteParameterServer, so the whole fleet is on the membership
     plane and churn handling is uniform.
+
+    ``ps_placement="spread"`` (DESIGN.md §17) deals the shard services
+    round-robin over PROCESSES instead of stacking them all on process 0:
+    the token travels first (everyone must authenticate their service
+    before any address exists), each process binds its assigned shards,
+    and the full address map is all-gathered — so the fleet aggregates
+    every host's NIC and survives a non-coordinator host loss outright.
+    Degenerates to "process0" at one process.
+
+    ``ps_standby=True`` adds the coordinator-failover plane: a dark
+    standby service (on shard 1's process under spread placement — a
+    different HOST than the coordinator) receives the coordinator's
+    write-behind authority log, and every client gets the standby's
+    address so a dead coordinator is re-resolved through the reconnect
+    path instead of ending the run (parallel/failover.py).
     """
     from jax.experimental import multihost_utils
 
@@ -736,12 +753,66 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
     ps_shards = int(ps_shards)
     if ps_shards < 1:
         raise ValueError(f"ps_shards must be >= 1, got {ps_shards}")
+    nproc = jax.process_count()
+    placement = elastic_mod.shard_placement(ps_shards, nproc, ps_placement)
+    spread = any(p != 0 for p in placement)
     pid = jax.process_index()
     codec_name = "raw" if runner.codec is None else runner.codec.name
     service = client = None
     services: list = []
+
+    def _make_ps(part):
+        ps = server_for(
+            runner.strategy,
+            jax.device_put(part, runner.devices[0]))
+        ps.num_updates = int(start_clock)
+        return ps
+
     try:
-        if pid == 0:
+        if spread:
+            # multi-host placement: the token travels FIRST (every hosting
+            # process must authenticate its services before any address
+            # exists), each process binds its assigned shards dark, and the
+            # complete address map is all-gathered before the fleet is
+            # cross-wired and started
+            if pid == 0:
+                import secrets
+
+                _, token = rps.share_service_address(
+                    [], token=secrets.token_hex(16))
+            else:
+                _, token = rps.share_service_address(None)
+            # the authoritative start state (checkpoint-restored on process
+            # 0) must seed EVERY hosting process's shards, not just 0's
+            init_params = multihost_utils.broadcast_one_to_all(
+                jax.tree.map(np.asarray, device_get_batched(init_params)))
+            from distkeras_tpu.parallel.distributed import \
+                determine_host_address
+            mine = [s for s in range(ps_shards) if placement[s] == pid]
+            standby_here = ps_standby and \
+                pid == elastic_mod.standby_process(placement)
+            services = elastic_mod.make_ps_fleet(
+                _make_ps, init_params, ps_shards,
+                expected_processes=nproc, token=token,
+                straggler=(StragglerDetector()
+                           if 0 in mine or standby_here else None),
+                advertise_host=determine_host_address(),
+                local_shards=mine, standby=standby_here)
+            for svc in services:
+                # the fleet telemetry sink lives on the coordinator shard,
+                # next to membership and history
+                if svc.shard == 0 and not svc.is_standby:
+                    svc.collector = TelemetryCollector()
+            addresses, standby_addr = elastic_mod.gather_fleet_addresses(
+                services, ps_shards)
+            elastic_mod.connect_fleet(
+                services, addresses, standby_address=standby_addr,
+                token=token)
+            client = elastic_mod.ShardedRemoteParameterServer(
+                addresses, init_params, timeout=history_timeout + 60.0,
+                token=token, codec=codec_name, standby=standby_addr)
+            local_ps = client
+        elif pid == 0:
             # symmetric go/no-go (ADVICE r5): if service construction fails
             # here, peers must RAISE at the address broadcast instead of
             # blocking in it until the collective timeout
@@ -749,19 +820,11 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
                 import secrets
 
                 token = secrets.token_hex(16)
-
-                def _make_ps(part):
-                    ps = server_for(
-                        runner.strategy,
-                        jax.device_put(part, runner.devices[0]))
-                    ps.num_updates = int(start_clock)
-                    return ps
-
-                if ps_shards == 1:
+                if ps_shards == 1 and not ps_standby:
                     ps = _make_ps(init_params)
                     service = rps.ParameterServerService(
                         ps, init_params,
-                        expected_processes=jax.process_count(),
+                        expected_processes=nproc,
                         port=service_port, token=token,
                         collector=TelemetryCollector())
                     service.start()
@@ -772,24 +835,30 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
                     # own detector only this process's — mixing the two
                     # feeds would double-count local workers
                     advertise = "127.0.0.1"
-                    if jax.process_count() > 1:
+                    if nproc > 1:
                         from distkeras_tpu.parallel.distributed import \
                             determine_host_address
                         advertise = determine_host_address()
                     services = elastic_mod.make_ps_fleet(
                         _make_ps, init_params, ps_shards,
-                        expected_processes=jax.process_count(),
+                        expected_processes=nproc,
                         token=token, straggler=StragglerDetector(),
-                        advertise_host=advertise)
+                        advertise_host=advertise, standby=ps_standby)
                     # the fleet telemetry sink lives on the coordinator
                     # shard, next to membership and history
                     services[0].collector = TelemetryCollector()
-                    ports = [svc.port for svc in services]
+                    ports = [svc.advertised for svc in services
+                             if not svc.is_standby]
+                    for svc in services:
+                        # standby rides the same broadcast, "~"-marked so
+                        # clients wire it as failover target, not a shard
+                        if svc.is_standby:
+                            ports.append("~" + svc.advertised)
             except Exception:
                 rps.share_service_address(None, error=True)
                 raise
             addr, _ = rps.share_service_address(ports, token=token)
-            if ps_shards == 1:
+            if ps_shards == 1 and not ps_standby:
                 local_ps = ps
                 if runner.codec is not None and runner.codec.name != "raw":
                     # process 0's workers skip the socket but must see the
@@ -800,17 +869,23 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
                 # loopback sharded client: process 0's workers join the
                 # same membership plane as everyone else's
                 client = elastic_mod.ShardedRemoteParameterServer(
-                    [f"127.0.0.1:{p}" for p in ports], init_params,
+                    [svc.advertised for svc in services
+                     if not svc.is_standby], init_params,
                     timeout=history_timeout + 60.0, token=token,
-                    codec=codec_name)
+                    codec=codec_name,
+                    standby=next((svc.advertised for svc in services
+                                  if svc.is_standby), None))
                 local_ps = client
         else:
             addr, token = rps.share_service_address(None)
-            addresses = addr.split(",")
+            entries = addr.split(",")
+            standby_addr = next(
+                (e[1:] for e in entries if e.startswith("~")), None)
+            addresses = [e for e in entries if not e.startswith("~")]
             # socket timeout must outlive the history barrier, or a slow
             # pod turns the server's informative barrier-timeout error
             # into a bare client-side socket.timeout
-            if len(addresses) == 1:
+            if len(addresses) == 1 and standby_addr is None:
                 client = rps.RemoteParameterServer(
                     addresses[0], init_params,
                     timeout=history_timeout + 60.0, token=token,
@@ -818,7 +893,7 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
             else:
                 client = elastic_mod.ShardedRemoteParameterServer(
                     addresses, init_params, timeout=history_timeout + 60.0,
-                    token=token, codec=codec_name)
+                    token=token, codec=codec_name, standby=standby_addr)
             local_ps = client
             # the authoritative start state lives at the center (matters on
             # resume: process 0 restored it; also seeds EASGD replicas)
@@ -837,27 +912,47 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
             client.put_history(pid, runner.merged_windows)
             merged, center, clock = client.get_history(
                 timeout=history_timeout)
-        # fleet telemetry aggregation: every remote process pushes its
-        # registry rows to the coordinator's collector (best-effort) after
-        # the history barrier, so the push rides an idle, settled fleet
+        # fleet telemetry aggregation: every process that does not HOST
+        # the coordinator pushes its registry rows to the coordinator's
+        # collector (best-effort) after the history barrier, so the push
+        # rides an idle, settled fleet
         reg = telemetry.get_registry()
-        if pid != 0 and reg is not None and client is not None:
+        hosts_coord = service is not None or any(
+            svc.shard == 0 and not svc.is_standby for svc in services)
+        if reg is not None and client is not None and (
+                pid != 0 or not hosts_coord):
             client.put_telemetry(pid, list(reg.rows()))
         # everyone holds the final state before process 0 tears the
         # service down (a late reader must not hit a dead socket); the
         # barrier also orders the pushes above before the merge below
         multihost_utils.sync_global_devices("distkeras_host_async_done")
         if pid == 0:
-            collector = (service.collector if service is not None
-                         else services[0].collector)
+            # the collector follows the coordinator: after a failover the
+            # promoted standby's re-mounted collector (seeded from the
+            # replicated mirror) holds the fleet rows, not the dead
+            # coordinator's
+            collector = service.collector if service is not None else None
+            promoted = [svc for svc in services
+                        if svc.standby is not None and svc.standby.promoted]
+            if promoted:
+                collector = promoted[-1].collector
+            elif collector is None:
+                for svc in services:
+                    if svc.shard == 0 and not svc.is_standby:
+                        collector = svc.collector
             if collector is not None:
                 runner.fleet_telemetry = collector.merged_rows(local_pid=0)
+            elif client is not None:
+                # spread fleet whose coordinator lives on another host
+                runner.fleet_telemetry = client.get_merged_telemetry()
     finally:
         if client is not None:
             client.close()
         if service is not None:
             service.stop()
         for svc in services:
+            if svc.replicator is not None:
+                svc.replicator.close(timeout=1.0)
             svc.stop()
     history = [step for _, _, steps in merged for step in steps]
     stal = [float(s) for _, s, _ in merged]

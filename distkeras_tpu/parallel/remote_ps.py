@@ -46,6 +46,7 @@ import jax
 import numpy as np
 
 from distkeras_tpu import comms, telemetry
+from distkeras_tpu.health import recorder as flight_recorder
 from distkeras_tpu.health.endpoints import HEALTH_OPS, handle_health_op
 from distkeras_tpu.health.membership import Membership
 from distkeras_tpu.parameter_servers import ParameterServer, \
@@ -116,6 +117,29 @@ class HistoryBarrierTimeout(RuntimeError, TimeoutError):
     silently proceeding with partial history. Also a RuntimeError: that
     is what this condition surfaced as before it was typed, and callers'
     broad handlers keep working."""
+
+
+class CoordinatorFenced(RuntimeError):
+    """The peer is a DEPOSED coordinator: a newer epoch holds the lease
+    (DESIGN.md §17). Carries the promoted coordinator's address and the
+    fencing epoch, so the sharded client re-resolves without a discovery
+    round-trip. A RuntimeError because that is what service error
+    replies raised before fencing was typed."""
+
+    def __init__(self, msg: str, coordinator: Optional[str] = None,
+                 epoch: int = 0):
+        super().__init__(msg)
+        self.coordinator = coordinator
+        self.epoch = int(epoch)
+
+
+#: Ops only the CURRENT coordinator may serve: a fenced (deposed)
+#: coordinator refuses these with a redirect, and a dark standby refuses
+#: them until promoted. Discovery (shard_map/coordinator), replication,
+#: promotion, and the health plane stay served in both states.
+COORD_OPS = ("pull", "commit", "register", "lease_renew", "deregister",
+             "clock", "history_put", "history_get", "telemetry_put",
+             "telemetry_merged")
 
 
 def check_token(expected: Optional[str], header: dict) -> bool:
@@ -246,6 +270,28 @@ class ParameterServerService:
         #: full fleet map ("host:port" per shard), set by the launcher once
         #: every shard is up; served to late joiners via the shard_map op
         self.shard_addresses: Optional[list] = None
+        # -- coordinator failover plane (parallel/failover.py) -------------
+        #: this service's own reachable address (set by the launcher; the
+        #: standby advertises it as the promoted coordinator address)
+        self.advertised: Optional[str] = None
+        #: the designated standby's address, broadcast to clients so their
+        #: reconnect path can re-resolve a dead coordinator
+        self.standby_address: Optional[str] = None
+        #: a standby service is DARK: coordinator ops refused until its
+        #: StandbyState promotes (which flips this back off)
+        self.is_standby = False
+        #: standby mirror + promotion state machine (StandbyState)
+        self.standby = None
+        #: the coordinator's write-behind log shipper (Replicator)
+        self.replicator = None
+        #: a deposed coordinator: a newer epoch fenced it; coordinator ops
+        #: are refused with a redirect instead of folding into a stale
+        #: center (split-brain guard)
+        self.fenced = False
+        self.fenced_by: Optional[dict] = None
+        #: the promotion epoch this service serves under (0 = the original
+        #: coordinator; each handoff increments it)
+        self.coord_epoch = 0
         self._dedup: dict = {}  # cid -> OrderedDict(seq -> commit reply)
         self._dedup_lock = threading.Lock()
         self._histories: dict[int, list] = {}
@@ -258,6 +304,8 @@ class ParameterServerService:
         self._running = False
         self._t_start = time.time()
         self._threads: list = []
+        self._conns: set = set()  # established connections, for kill()
+        self._conn_lock = threading.Lock()
 
     # -- lifecycle (reference vocabulary) ---------------------------------
     def start(self) -> None:
@@ -299,10 +347,53 @@ class ParameterServerService:
         except OSError:
             pass
 
+    def kill(self, reason: str = "chaos") -> None:
+        """Simulate PROCESS DEATH for this service (the chaos "kill"
+        action): unlike :meth:`stop` — which leaves established
+        connections serving — the listener AND every live connection die
+        instantly, in-flight requests get no reply, and the flight
+        recorder dumps this side's postmortem (carrying the failover
+        event) exactly as a crashing coordinator's would."""
+        if not self._running:
+            return
+        telemetry.counter("elastic.failover.kills").inc()
+        telemetry.record_event("failover", transition="killed",
+                               shard=self.shard, reason=reason,
+                               clock=int(self.ps.num_updates))
+        if self.replicator is not None:
+            self.replicator.close(timeout=0.2)  # the log dies with us
+        self.stop()
+        with self._conn_lock:
+            conns, self._conns = list(self._conns), set()
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        flight_recorder.auto_dump(
+            "coordinator_killed" if self.shard == 0
+            else f"shard{self.shard}_killed")
+
+    def fence(self, epoch: int, coordinator: Optional[str] = None) -> None:
+        """Depose this (former) coordinator: a standby promoted under a
+        newer epoch. Coordinator ops now refuse with a typed redirect —
+        a fenced center must never fold another commit."""
+        self.fenced = True
+        self.fenced_by = {"epoch": int(epoch),
+                          "coordinator": coordinator or self.standby_address}
+        telemetry.record_event("failover", transition="deposed",
+                               shard=self.shard, epoch=int(epoch))
+
     # -- per-connection handler (reference: handle_connection) ------------
     def _serve(self, conn: socket.socket):
         inflight = telemetry.gauge("remote_ps.server.inflight_connections")
         inflight.add(1)
+        with self._conn_lock:
+            self._conns.add(conn)
         codec = self.codec  # per-connection: hello may swap the wire codec
         try:
             with conn:
@@ -335,15 +426,26 @@ class ParameterServerService:
             if self._running:  # surface handler crashes, don't die silently
                 raise
         finally:
+            with self._conn_lock:
+                self._conns.discard(conn)
             inflight.add(-1)
 
     def _dispatch(self, conn, header: dict, blobs: list,
                   codec: Optional[_TreeCodec] = None):
         op = header["op"]
-        act = fault.chaos("remote_ps.server.handle")
+        # the standby replicates shard 0 but is a DIFFERENT process: give
+        # it a distinct chaos identity so `shard=0` targets exactly the
+        # coordinator (and `shard=-1` exactly the standby)
+        act = fault.chaos("remote_ps.server.handle",
+                          shard=-1 if self.is_standby else self.shard)
         if act is not None:
             if act.action == "delay":
                 time.sleep(act.delay_s)  # a stalled shard, from outside
+            elif act.action == "kill":
+                # process death, not a connection blip: the whole service
+                # (listener + every connection) dies under the caller
+                self.kill(reason="chaos")
+                raise ConnectionError("chaos: service killed")
             else:  # either reset flavor: kill the connection, no reply
                 conn.close()
                 raise ConnectionError("chaos: server reset the connection")
@@ -380,6 +482,22 @@ class ParameterServerService:
 
     def _dispatch_op(self, conn, op: str, header: dict, blobs: list,
                      codec: _TreeCodec):
+        if op in COORD_OPS and self.fenced:
+            # deposed coordinator: refuse with a redirect to the epoch
+            # holder — a fenced center must never fold another commit
+            fb = self.fenced_by or {}
+            _sendall(conn, {
+                "error": "coordinator fenced: epoch "
+                         f"{fb.get('epoch', 0)} promoted at "
+                         f"{fb.get('coordinator')}",
+                "error_kind": "fenced",
+                "coordinator": fb.get("coordinator"),
+                "epoch": fb.get("epoch", 0)})
+            return
+        if op in COORD_OPS and self.is_standby:
+            _sendall(conn, {"error": "standby shard is dark until "
+                                     "promoted", "error_kind": "standby"})
+            return
         if op == "pull":
             center, clock = self.ps.pull()
             self._reply(conn, op, {"clock": clock},
@@ -419,6 +537,14 @@ class ParameterServerService:
             reply = {"at_fold": at_fold, "weight": applied}
             if cid is not None and seq is not None:
                 self._dedup_put(cid, seq, reply)
+            if self.replicator is not None:
+                # write-behind: the fold's verdict + the RAW received
+                # blobs ship to the standby asynchronously (zero
+                # re-encode, zero added latency on this reply)
+                self.replicator.record_commit(
+                    blobs=blobs, codec=codec.wire.name, at_fold=at_fold,
+                    weight=applied, last_update=header["last_update"],
+                    cid=cid, seq=seq)
             self._reply(conn, op, reply)
         elif op == "register":
             if self.membership is None:
@@ -447,6 +573,9 @@ class ParameterServerService:
             with self._hist_cv:
                 self._histories[int(header["pid"])] = header["windows"]
                 self._hist_cv.notify_all()
+            if self.replicator is not None:
+                self.replicator.record_history(int(header["pid"]),
+                                               header["windows"])
             self._reply(conn, op, {"ok": True})
         elif op == "history_get":
             # blocks until EVERY process uploaded — the end-of-run barrier.
@@ -481,12 +610,43 @@ class ParameterServerService:
             else:
                 res = self.collector.add_batch(header.get("pid", -1),
                                                header.get("rows", []))
+                if self.replicator is not None:
+                    self.replicator.record_telemetry(
+                        header.get("pid", -1), header.get("rows", []))
                 self._reply(conn, op, dict(res, ok=True))
         elif op == "telemetry_merged":
             rows = ([] if self.collector is None
                     else self.collector.merged_rows())
             self._reply(conn, op, {"ok": self.collector is not None,
                                    "rows": rows})
+        elif op == "repl_append":
+            # the coordinator's write-behind log arriving at the standby
+            if self.standby is None:
+                _sendall(conn, {"error": "not a standby: no replication "
+                                         "state mounted"})
+            else:
+                self._reply(conn, op, self.standby.handle_append(header,
+                                                                 blobs))
+        elif op == "coord_lease":
+            # the coordinator's heartbeat: lease renewal + authority
+            # snapshot (clock, membership export)
+            if self.standby is None:
+                _sendall(conn, {"error": "not a standby: no replication "
+                                         "state mounted"})
+            else:
+                self._reply(conn, op, self.standby.handle_lease(header))
+        elif op == "coordinator":
+            # discovery: who holds the coordinator lease? On a standby
+            # this lazily notices a lapsed lease and promotes (the
+            # worker's reconnect path is the failure detector)
+            self._reply(conn, op, self.coordinator_view())
+        elif op == "promote":
+            if self.standby is None:
+                _sendall(conn, {"error": "not a standby: nothing to "
+                                         "promote"})
+            else:
+                self._reply(conn, op, self.standby.handle_promote(
+                    force=bool(header.get("force", False))))
         elif op in HEALTH_OPS:
             # live health plane (DESIGN.md §9): header-only introspection
             # sharing this connection's framing + token auth
@@ -501,6 +661,16 @@ class ParameterServerService:
                 "port": self.port,
                 "shard": self.shard,
                 "num_shards": self.num_shards,
+                # failover discovery hints: HealthClient caches these so
+                # a later connection loss can follow the coordinator move
+                **({"shard_addresses": list(self.shard_addresses)}
+                   if self.shard_addresses else {}),
+                **({"standby": self.standby_address}
+                   if self.standby_address else {}),
+                **({"coord_epoch": self.coord_epoch}
+                   if self.coord_epoch else {}),
+                **({"is_standby": True} if self.is_standby else {}),
+                **({"fenced": self.fenced_by} if self.fenced else {}),
                 **({"membership": self.membership.status()}
                    if self.membership is not None else {}),
             }))
@@ -520,12 +690,31 @@ class ParameterServerService:
             while len(replies) > self.DEDUP_CACHE:
                 replies.popitem(last=False)
 
+    def coordinator_view(self) -> dict:
+        """Where this service believes the coordinator lease lives. A
+        standby answers from its promotion state machine (and may promote
+        while answering); everyone else answers from the fleet map."""
+        if self.standby is not None:
+            return self.standby.coordinator_view()
+        if self.fenced:
+            fb = self.fenced_by or {}
+            return {"address": fb.get("coordinator"),
+                    "epoch": fb.get("epoch", 0), "promoted": True,
+                    "standby": self.standby_address}
+        return {"address": (self.shard_addresses[0]
+                            if self.shard_addresses else self.advertised),
+                "epoch": self.coord_epoch, "promoted": self.coord_epoch > 0,
+                "standby": self.standby_address}
+
     # -- direct (in-process) counterparts for process 0 -------------------
     def put_history(self, pid: int, windows: list) -> None:
+        windows = [[int(c), float(s), steps] for c, s, steps in windows]
         with self._hist_cv:
-            self._histories[int(pid)] = [
-                [int(c), float(s), steps] for c, s, steps in windows]
+            self._histories[int(pid)] = windows
             self._hist_cv.notify_all()
+        if self.replicator is not None:
+            # process 0's direct upload replicates like the wire one
+            self.replicator.record_history(int(pid), windows)
 
     def get_history_blocking(self, timeout: float = 600):
         with self._hist_cv:
@@ -809,6 +998,10 @@ class RemoteParameterServer:
         if "error" in resp:
             if resp.get("error_kind") == "history-timeout":
                 raise HistoryBarrierTimeout(resp["error"])
+            if resp.get("error_kind") == "fenced":
+                raise CoordinatorFenced(resp["error"],
+                                        resp.get("coordinator"),
+                                        resp.get("epoch", 0))
             raise RuntimeError(f"parameter service: {resp['error']}")
         return resp, rblobs
 
@@ -838,6 +1031,10 @@ class RemoteParameterServer:
                 self._ctrl_sock = None
                 raise
         if "error" in resp:
+            if resp.get("error_kind") == "fenced":
+                raise CoordinatorFenced(resp["error"],
+                                        resp.get("coordinator"),
+                                        resp.get("epoch", 0))
             raise RuntimeError(f"parameter service: {resp['error']}")
         return resp
 
@@ -930,6 +1127,21 @@ class RemoteParameterServer:
         ``{shard, num_shards, addresses}`` (late-joiner bootstrap)."""
         return self._control_roundtrip({"op": "shard_map"})
 
+    # -- coordinator failover (DESIGN.md §17) ------------------------------
+    def coordinator_view(self) -> dict:
+        """Who holds the coordinator lease, per this peer. Asking a
+        STANDBY is the failure detector: a lapsed coordinator lease is
+        noticed (and promotion performed) while this query is answered."""
+        return self._control_roundtrip({"op": "coordinator"})
+
+    def promote(self, force: bool = False) -> dict:
+        """Ask a standby to promote (``force=True`` skips the lease-lapse
+        check — deterministic handoffs in tests and failover drills).
+        Returns ``{promoted, epoch, reason, address}``; a standby that
+        already promoted rejects the second promotion."""
+        return self._control_roundtrip({"op": "promote",
+                                        "force": bool(force)})
+
     # -- end-of-run history barrier ---------------------------------------
     def put_history(self, pid: int, windows: list) -> None:
         self._roundtrip({"op": "history_put", "pid": int(pid),
@@ -1009,6 +1221,15 @@ def share_service_address(ports,
     produces byte-for-byte the single-server payload, so N=1 stays
     wire-compatible. Callers split the returned address on ``","``.
 
+    Entries that are already STRINGS pass through verbatim (DESIGN.md
+    §17): spread placement broadcasts full cross-host ``host:port``
+    addresses gathered from every hosting process, and the designated
+    standby rides the same payload as a ``~host:port`` entry — old
+    callers that pass bare ports see byte-identical payloads. An EMPTY
+    ``ports`` list broadcasts just the token (``|token``): the
+    token-first handshake spread placement needs before any process can
+    bind an authenticated service.
+
     ``error=True`` (process 0 only) broadcasts a failure sentinel instead —
     the symmetric-agreement half of service construction (ADVICE r5): if
     process 0 could not bring the service up, its peers RAISE here instead
@@ -1022,12 +1243,14 @@ def share_service_address(ports,
     port_list = list(ports) if isinstance(ports, (list, tuple)) \
         else [ports]
     if jax.process_count() == 1:
-        return ",".join(f"127.0.0.1:{p}" for p in port_list), token
+        return ",".join(e if isinstance(e, str) else f"127.0.0.1:{e}"
+                        for e in port_list), token
     payload = np.zeros((512,), np.uint8)  # sized for a multi-shard map
     if jax.process_index() == 0:
         host = determine_host_address()
         msg = ("!service construction failed on process 0" if error
-               else ",".join(f"{host}:{p}" for p in port_list)
+               else ",".join(e if isinstance(e, str) else f"{host}:{e}"
+                             for e in port_list)
                + f"|{token or ''}")
         raw = msg.encode()
         if len(raw) > payload.size:

@@ -110,6 +110,33 @@ class ParameterServer:
         self._note_commit(staleness, time.perf_counter() - t0)
         return at_fold, w
 
+    def replay(self, delta: Any, at_fold: int, weight: float,
+               last_update: int = 0) -> int:
+        """Apply one write-behind-log record (parallel/failover.py): the
+        standby's replica folds the SAME delta at the SAME clock with the
+        SAME float32 weight through the SAME jitted ``_fold`` the
+        coordinator used, so the replica's center is bit-identical to the
+        coordinator's after every applied record — the numerics half of
+        the ``(at_fold, applied_weight)`` promotion contract.
+
+        The clock is pinned to ``at_fold`` BEFORE folding. Returns the
+        clock gap that pin closed (0 when the log stream is complete; a
+        positive gap means records were lost between coordinator and
+        standby — the caller accounts it honestly instead of silently
+        diverging the clock too)."""
+        delta = self._to_center_device(delta)
+        t0 = time.perf_counter()
+        with telemetry.span("trace.fold", replay=True):
+            with self._lock:
+                gap = int(at_fold) - self.num_updates
+                self.num_updates = int(at_fold)
+                self.center_variable = _fold(self.center_variable, delta,
+                                             jnp.float32(float(weight)))
+                self.num_updates += 1
+        self._note_commit(int(at_fold) - int(last_update),
+                          time.perf_counter() - t0)
+        return gap
+
     def _to_center_device(self, tree: Any) -> Any:
         """Bring a worker's delta to the center's device — the explicit
         device-to-device hop that the reference's executor→driver TCP send

@@ -90,6 +90,16 @@ METRIC_NAMES = {
     "data.prefetch.queue_depth_samples": "histogram",
     # elastic fleet membership (health/membership.py + remote_ps commits)
     "elastic.evictions": "counter",
+    # coordinator failover plane (parallel/failover.py, DESIGN.md §17)
+    "elastic.failover.epoch": "gauge",
+    "elastic.failover.fenced": "counter",
+    "elastic.failover.kills": "counter",
+    "elastic.failover.promotions": "counter",
+    "elastic.failover.repl_dropped": "counter",
+    "elastic.failover.repl_errors": "counter",
+    "elastic.failover.repl_lag": "gauge",
+    "elastic.failover.repl_records": "counter",
+    "elastic.failover.resolves": "counter",
     "elastic.late_folds": "counter",
     "elastic.readmissions": "counter",
     "elastic.workers": "gauge",

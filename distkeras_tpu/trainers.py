@@ -110,13 +110,13 @@ class Trainer:
         self.training_time: float = 0.0
 
     # -- checkpointing (per-epoch; the reference had NONE — SURVEY.md §5) ---
-    def _checkpointer(self, local_host_only: bool = False):
+    def _checkpointer(self, local_host_only: bool = False, items=None):
         if self.checkpoint_dir is None:
             return None
         from distkeras_tpu.checkpoint import Checkpointer
 
         return Checkpointer(self.checkpoint_dir,
-                            local_host_only=local_host_only)
+                            local_host_only=local_host_only, items=items)
 
     @staticmethod
     def _check_fresh_dir(ckpt) -> None:
@@ -340,6 +340,8 @@ class DistributedTrainer(Trainer):
                  precision: Optional[str] = None,
                  bucket_bytes: Optional[int] = None,
                  ps_shards: int = 1,
+                 ps_placement: str = "process0",
+                 ps_standby: bool = False,
                  **strategy_kwargs):
         super().__init__(model, loss, worker_optimizer, learning_rate,
                          metrics, features_col, label_col, batch_size,
@@ -447,6 +449,23 @@ class DistributedTrainer(Trainer):
             raise ValueError(
                 "ps_shards shards the host_async parameter service; sync "
                 "mode has no parameter server to shard")
+        # shard placement + coordinator failover (DESIGN.md §17): "spread"
+        # deals the shard services over processes instead of stacking them
+        # on process 0; ps_standby=True runs a dark coordinator replica
+        # that promotes via lease handoff when the coordinator dies.
+        from distkeras_tpu.parallel.elastic import PLACEMENT_POLICIES
+
+        if ps_placement not in PLACEMENT_POLICIES:
+            raise ValueError(f"ps_placement must be one of "
+                             f"{PLACEMENT_POLICIES}, got {ps_placement!r}")
+        if mode != "host_async" and (ps_placement != "process0"
+                                     or ps_standby):
+            raise ValueError(
+                "ps_placement/ps_standby configure the host_async "
+                "parameter-service fleet; sync mode has no parameter "
+                "server to place or fail over")
+        self.ps_placement = ps_placement
+        self.ps_standby = bool(ps_standby)
         # health monitoring (DESIGN.md §9): None | policy string | dict |
         # HealthConfig — normalized here so a bad policy fails at
         # construction. A fresh TrainingWatchdog is built per train() call
@@ -552,21 +571,41 @@ class DistributedTrainer(Trainer):
         step = ckpt.latest_step()
         if step is None:
             return center, carries, zero, 0
-        meta = ckpt.metadata(step)
-        if not isinstance(meta, dict) or "carries" not in meta or \
-                meta["carries"] is None:
-            keys = sorted(meta) if isinstance(meta, dict) else type(meta)
-            raise ValueError(
-                f"checkpoint step {step} in {ckpt.directory!r} has no "
-                f"'carries' item (found {keys}); it was written by a "
-                f"different mode/trainer (host_async snapshots are "
-                f"center+clock, PjitTrainer/SingleTrainer save a "
-                f"TrainState). Resume it with the mode it was written in.")
-        carry_meta = jax.tree.leaves(meta["carries"])
+        # steps written before the state/carries item split keep the old
+        # single-item layout in the same directory — the step directory
+        # itself says which format it is (Checkpointer.step_items)
+        legacy = "default" in ckpt.step_items(step)
+        if legacy:
+            meta = ckpt.metadata(step)
+            if not isinstance(meta, dict) or "carries" not in meta or \
+                    meta["carries"] is None:
+                keys = sorted(meta) if isinstance(meta, dict) else type(meta)
+                raise ValueError(
+                    f"checkpoint step {step} in {ckpt.directory!r} has no "
+                    f"'carries' item (found {keys}); it was written by a "
+                    f"different mode/trainer (host_async snapshots are "
+                    f"center+clock, PjitTrainer/SingleTrainer save a "
+                    f"TrainState). Resume it with the mode it was written "
+                    f"in.")
+            carries_meta = meta["carries"]
+            counters_shape = tuple(meta["counters"].shape)
+        else:
+            names = ckpt.step_items(step)
+            if "state" not in names or "carries" not in names:
+                raise ValueError(
+                    f"checkpoint step {step} in {ckpt.directory!r} has "
+                    f"items {names}, not the state+carries pair this "
+                    f"trainer writes; it was written by a different "
+                    f"mode/trainer. Resume it with the mode it was "
+                    f"written in.")
+            carries_meta = ckpt.metadata(step, item="carries")
+            counters_shape = tuple(
+                ckpt.metadata(step, item="state")["counters"].shape)
+        carry_meta = jax.tree.leaves(carries_meta)
         saved_workers = int(carry_meta[0].shape[0])
         # counters length may be 2 (pre-r5 format, no worker count
         # recorded); numpy abstract = host restore, no sharding lookup
-        counters_like = np.zeros(tuple(meta["counters"].shape), np.int64)
+        counters_like = np.zeros(counters_shape, np.int64)
 
         def parse_counters(raw) -> np.ndarray:
             out = zero.copy()
@@ -590,11 +629,18 @@ class DistributedTrainer(Trainer):
                     f"does not match this trainer's "
                     f"strategy ({self.strategy.name!r}); resuming needs "
                     f"the same strategy the checkpoint was written with")
+            if legacy:
+                snap = ckpt.restore_legacy(
+                    like={"center": center, "carries": carries,
+                          "counters": counters_like}, step=step)
+                return (snap["center"], snap["carries"],
+                        parse_counters(snap["counters"]), step + 1)
             snap = ckpt.restore(
-                like={"center": center, "carries": carries,
-                      "counters": counters_like}, step=step)
-            return (snap["center"], snap["carries"],
-                    parse_counters(snap["counters"]), step + 1)
+                like={"state": {"center": center,
+                                "counters": counters_like},
+                      "carries": carries}, step=step)
+            return (snap["state"]["center"], snap["carries"],
+                    parse_counters(snap["state"]["counters"]), step + 1)
         if not self.strategy.exchanges:
             raise ValueError(
                 f"Cannot elastically resume {type(self).__name__} across a "
@@ -615,26 +661,38 @@ class DistributedTrainer(Trainer):
             f"optimizer slots) is discarded, so the continuation is a "
             f"documented trajectory break from the uninterrupted run.",
             RuntimeWarning, stacklevel=3)
-        # Restore EVERYTHING to host numpy: numpy abstracts carry no
-        # sharding, so Orbax never consults the checkpoint's sharding file
-        # (which references the OLD device topology — the exact thing a
-        # slice-resize resume no longer has). The wrong-topology carries
-        # are read into host RAM and discarded; only the center survives,
-        # re-placed by _init_carries on the new mesh. (Cost: one host-RAM
-        # read of the old carries; a future format split of carries into
-        # their own checkpoint item would skip even that.)
+        # Restore to host numpy: numpy abstracts carry no sharding, so
+        # Orbax never consults the checkpoint's sharding file (which
+        # references the OLD device topology — the exact thing a
+        # slice-resize resume no longer has). Only the center survives,
+        # re-placed by _init_carries on the new mesh.
         center_host_like = jax.tree.map(
             lambda x: np.zeros(np.shape(x), np.asarray(x).dtype),
             device_get_batched(center))
-        abstract_saved = jax.tree.map(
-            lambda m: np.zeros(tuple(m.shape), np.dtype(str(m.dtype))),
-            meta["carries"])
-        snap = ckpt.restore(
-            like={"center": center_host_like, "carries": abstract_saved,
-                  "counters": np.zeros(tuple(meta["counters"].shape),
-                                       np.int64)}, step=step, host=True)
-        new_center, new_carries = self._init_carries(snap["center"])
-        return (new_center, new_carries, parse_counters(snap["counters"]),
+        counters_host_like = np.zeros(counters_shape, np.int64)
+        if legacy:
+            # single-item step: the wrong-topology carries are structurally
+            # part of the item, so they are read into host RAM and
+            # discarded — the cost the state/carries split removes
+            abstract_saved = jax.tree.map(
+                lambda m: np.zeros(tuple(m.shape), np.dtype(str(m.dtype))),
+                carries_meta)
+            snap = ckpt.restore_legacy(
+                like={"center": center_host_like,
+                      "carries": abstract_saved,
+                      "counters": counters_host_like}, step=step, host=True)
+            new_center, counters_raw = snap["center"], snap["counters"]
+        else:
+            # split layout: read ONLY the state item — the stale carries'
+            # array data never leaves disk (DESIGN.md §6)
+            snap = ckpt.restore(
+                like={"state": {"center": center_host_like,
+                                "counters": counters_host_like}},
+                step=step, host=True, items=("state",))
+            new_center = snap["state"]["center"]
+            counters_raw = snap["state"]["counters"]
+        new_center, new_carries = self._init_carries(new_center)
+        return (new_center, new_carries, parse_counters(counters_raw),
                 step + 1)
 
     def train(self, dataset: Dataset, shuffle: bool = False,
@@ -691,7 +749,12 @@ class DistributedTrainer(Trainer):
             self._warn_if_large_resident(dataset, "staging_rounds")
         with span("trainer.init"):
             center, carries = self._setup_state(dataset)
-        ckpt = self._checkpointer()
+        # carries live in their OWN checkpoint item (DESIGN.md §6): they
+        # dominate the snapshot bytes and are exactly what a topology-change
+        # resume throws away, so splitting them lets that resume read only
+        # the small 'state' item. Pre-split single-item steps stay readable
+        # (Checkpointer.restore_legacy).
+        ckpt = self._checkpointer(items=("state", "carries"))
         if ckpt is not None:
             try:
                 center, carries, counters, start_epoch = \
@@ -765,10 +828,12 @@ class DistributedTrainer(Trainer):
             if ckpt is not None:
                 # counters[2] records the topology so a later resume can
                 # detect a worker-count change before any shape restore
-                ckpt.save(epoch, {"center": center, "carries": carries,
-                                  "counters": np.array(
-                                      [round_offset, self.num_updates,
-                                       self.num_workers], np.int64)})
+                ckpt.save(epoch, {
+                    "state": {"center": center,
+                              "counters": np.array(
+                                  [round_offset, self.num_updates,
+                                   self.num_workers], np.int64)},
+                    "carries": carries})
         if ckpt is not None:
             ckpt.wait()
             ckpt.close()
@@ -959,7 +1024,9 @@ class DistributedTrainer(Trainer):
                             runner, init_params, epoch_shards,
                             worker_offset=worker_offset, checkpointer=ckpt,
                             checkpoint_folds=folds, start_clock=start_clock,
-                            watchdog=watchdog, ps_shards=self.ps_shards)
+                            watchdog=watchdog, ps_shards=self.ps_shards,
+                            ps_placement=self.ps_placement,
+                            ps_standby=self.ps_standby)
                 else:
                     params, history, staleness, num_updates = runner.run(
                         init_params, epoch_shards, checkpointer=ckpt,

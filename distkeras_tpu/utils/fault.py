@@ -104,7 +104,9 @@ def apply(site: str, value: float) -> float:
 
 #: Actions a chaos site may be armed with. Semantics are implemented at
 #: the call site (the site knows its socket); this module only meters.
-CHAOS_ACTIONS = ("drop", "delay", "reset", "reset_after_send")
+#: "kill" is the strongest: the serving SERVICE dies (listener + every
+#: connection — simulated process death), not just one connection.
+CHAOS_ACTIONS = ("drop", "delay", "reset", "reset_after_send", "kill")
 
 
 class ChaosAction:
@@ -118,27 +120,33 @@ class ChaosAction:
 
 
 class _ChaosInjection:
-    __slots__ = ("action", "delay_s", "after", "count", "skipped", "fired")
+    __slots__ = ("action", "delay_s", "after", "count", "skipped", "fired",
+                 "shard")
 
     def __init__(self, action: str, delay_s: float, after: int,
-                 count: Optional[int]):
+                 count: Optional[int], shard: Optional[int] = None):
         self.action = action
         self.delay_s = float(delay_s)
         self.after = int(after)
         self.count = count
         self.skipped = 0
         self.fired = 0
+        self.shard = shard
 
 
 _chaos: dict = {}
 
 
 def inject_chaos(site: str, action: str, after: int = 0,
-                 count: Optional[int] = 1, delay_s: float = 0.0) -> None:
+                 count: Optional[int] = 1, delay_s: float = 0.0,
+                 shard: Optional[int] = None) -> None:
     """Arm a transport fault at ``site``: the first ``after`` passes through
     :func:`chaos` are clean, then the next ``count`` (default ONE — chaos
     faults are usually reset-once scripts; None = every subsequent one)
-    return the armed action. Sites in use:
+    return the armed action. ``shard=`` restricts the fault to call sites
+    that identify as that shard (coordinator-kill drills arm
+    ``shard=0``); passes from other shards neither fire nor consume the
+    ``after``/``count`` budget. Sites in use:
 
     - ``"remote_ps.send"`` — client request egress
       (:meth:`RemoteParameterServer._roundtrip`): ``reset`` raises before
@@ -148,13 +156,16 @@ def inject_chaos(site: str, action: str, after: int = 0,
       the reply wait hits the per-op timeout.
     - ``"remote_ps.server.handle"`` — server-side dispatch
       (:meth:`ParameterServerService._dispatch`): ``delay`` stalls the
-      shard, ``reset`` closes the connection instead of replying.
+      shard, ``reset`` closes the connection instead of replying,
+      ``kill`` takes the whole service down (DESIGN.md §17's
+      coordinator-death drill).
     """
     if action not in CHAOS_ACTIONS:
         raise ValueError(f"chaos action must be one of {CHAOS_ACTIONS}, "
                          f"got {action!r}")
     with _inj_lock:
-        _chaos[site] = _ChaosInjection(action, delay_s, after, count)
+        _chaos[site] = _ChaosInjection(action, delay_s, after, count,
+                                       shard=shard)
 
 
 def clear_chaos(site: Optional[str] = None) -> None:
@@ -166,17 +177,23 @@ def clear_chaos(site: Optional[str] = None) -> None:
             _chaos.pop(site, None)
 
 
-def chaos(site: str) -> Optional[ChaosAction]:
+def chaos(site: str, shard: Optional[int] = None) -> Optional[ChaosAction]:
     """Pass a transport control point through the chaos hook for ``site``.
     Returns the armed :class:`ChaosAction` when this pass fires, else None
     (always None when nothing is armed — the no-chaos fast path is one
-    dict lookup). Thread-safe; budgets are consumed exactly once."""
+    dict lookup). ``shard=`` identifies the caller for shard-filtered
+    injections; a filter mismatch is a clean pass that consumes no
+    budget. Thread-safe; budgets are consumed exactly once."""
     inj = _chaos.get(site)
     if inj is None:
+        return None
+    if inj.shard is not None and shard != inj.shard:
         return None
     with _inj_lock:
         inj = _chaos.get(site)
         if inj is None:
+            return None
+        if inj.shard is not None and shard != inj.shard:
             return None
         if inj.skipped < inj.after:
             inj.skipped += 1

@@ -20,7 +20,7 @@ PKG_MODULES = sorted(
 
 def test_discovery_found_the_tools():
     # the floor protects against the glob silently matching nothing
-    assert len(SCRIPTS) >= 14, SCRIPTS
+    assert len(SCRIPTS) >= 15, SCRIPTS
     assert "distkeras_tpu.benchmarks.run_config" in PKG_MODULES
     # the serving load generator (ISSUE 2) must be under the smoke glob
     assert any(os.path.basename(p) == "serving_load.py" for p in SCRIPTS)
@@ -41,6 +41,9 @@ def test_discovery_found_the_tools():
     assert any(os.path.basename(p) == "attribution.py" for p in SCRIPTS)
     # the perf-regression sentinel (ISSUE 11) too
     assert any(os.path.basename(p) == "regression_gate.py"
+               for p in SCRIPTS)
+    # the coordinator-failover probe (ISSUE 12) too
+    assert any(os.path.basename(p) == "failover_probe.py"
                for p in SCRIPTS)
 
 

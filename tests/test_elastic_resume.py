@@ -164,3 +164,31 @@ def test_legacy_two_counter_checkpoint_resumes(tmp_path):
     t2.train(ds, resume=True)
     rounds = 1024 // 4 // 16
     assert t2.num_updates == 28 + rounds * 4  # clock continued
+
+
+def test_checkpoints_split_carries_into_their_own_item(tmp_path):
+    """DESIGN §6: sync-mode checkpoints are a state+carries composite so
+    a topology-change resume restores ``state`` only — the old
+    topology's carries never leave disk."""
+    from distkeras_tpu.checkpoint import Checkpointer
+
+    ds = synthetic_mnist(n=1024)
+    t = ADAG(_model(), num_workers=8, num_epoch=1,
+             checkpoint_dir=str(tmp_path / "ck"), **_kw())
+    t.train(ds)
+
+    ck = Checkpointer(str(tmp_path / "ck"), items=("state", "carries"))
+    try:
+        step = ck.latest_step()
+        assert step is not None
+        assert ck.step_items(step) == ["carries", "state"]
+        # a partial restore materializes ONLY the requested item
+        like = {"state": {
+            "center": t.params,
+            "counters": np.zeros((3,), np.int64)}}
+        out = ck.restore(like=like, step=step, host=True,
+                         items=("state",))
+        assert set(out) == {"state"}
+        assert int(out["state"]["counters"][1]) == t.num_updates
+    finally:
+        ck.close()

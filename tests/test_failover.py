@@ -1,0 +1,363 @@
+"""Coordinator failover tests (DESIGN.md §17): placement, lease handoff,
+promotion numerics, and the end-to-end coordinator-kill chaos run.
+
+Layers, bottom-up: the pure placement map; the lease-fencing state
+machine (double promotion rejected, deposed coordinator fenced by
+epoch); a deterministic sharded DynSGD commit schedule whose
+``(at_fold, applied_weight)`` trajectory must be IDENTICAL across a
+mid-schedule coordinator kill + standby promotion; the health client
+following the coordinator move; and the acceptance run — a live
+training loop whose coordinator is chaos-killed mid-run, finishing with
+zero lost windows and a flight-recorder postmortem carrying the
+failover event.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.comms import RetryPolicy
+from distkeras_tpu.health import recorder as flight_recorder
+from distkeras_tpu.health.endpoints import HealthClient
+from distkeras_tpu.parallel import elastic
+from distkeras_tpu.parallel.elastic import (
+    ShardedRemoteParameterServer,
+    make_ps_fleet,
+)
+from distkeras_tpu.parallel.remote_ps import (
+    CoordinatorFenced,
+    PSUnavailable,
+    RemoteParameterServer,
+)
+from distkeras_tpu.parameter_servers import DynSGDParameterServer
+from distkeras_tpu.utils import fault
+
+PARAMS = {"w": jnp.ones((4, 3), jnp.float32),
+          "b": jnp.zeros((3,), jnp.float32),
+          "s": jnp.full((2,), 2.0, jnp.float32)}
+
+FAST = dict(retry=RetryPolicy(max_retries=3, base_s=0.01, max_s=0.05),
+            op_timeout=5.0)
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    telemetry.reset()
+    fault.clear_chaos()
+    # auto_dump is once-per-reason per PROCESS: clear the dumped-reason
+    # set so each test's coordinator kill produces its own bundle
+    flight_recorder.get_recorder().clear()
+    yield
+    fault.clear_chaos()
+    flight_recorder.configure(dump_dir=None)
+    flight_recorder.get_recorder().clear()
+    telemetry.reset()
+
+
+def _counter(name: str) -> int:
+    snap = telemetry.get_registry().snapshot()
+    return sum(v for k, v in snap["counters"].items()
+               if k.split("{", 1)[0] == name)
+
+
+def _fleet(num_shards=2, **kw):
+    return make_ps_fleet(
+        lambda part: DynSGDParameterServer(jax.device_put(part)),
+        PARAMS, num_shards, **kw)
+
+
+def _stop(services):
+    for svc in services:
+        if svc.replicator is not None:
+            svc.replicator.close(timeout=0.5)
+        svc.stop()
+
+
+def _ones(like):
+    return jax.tree.map(lambda l: np.ones(np.shape(l), np.float32), like)
+
+
+def _standby_client(services, **kw):
+    """Client over the fleet's non-standby shards, standby hint wired."""
+    return ShardedRemoteParameterServer(
+        [svc.advertised for svc in services if not svc.is_standby],
+        PARAMS, standby=services[-1].advertised, **kw)
+
+
+# -- placement map -----------------------------------------------------------
+
+def test_shard_placement_policies():
+    assert elastic.shard_placement(4, 3, "process0") == [0, 0, 0, 0]
+    assert elastic.shard_placement(5, 3, "spread") == [0, 1, 2, 0, 1]
+    # spread degenerates to process0 at one process (the tier-1 topology)
+    assert elastic.shard_placement(4, 1, "spread") == [0, 0, 0, 0]
+    # pure function of (shards, processes, policy): every process
+    # computes the identical map, so only addresses ever travel
+    assert elastic.shard_placement(7, 4, "spread") == \
+        elastic.shard_placement(7, 4, "spread")
+    # the standby lives on shard 1's process — not the coordinator's —
+    # whenever the placement spans more than one process
+    assert elastic.standby_process([0, 1, 2]) == 1
+    assert elastic.standby_process([0]) == 0
+    with pytest.raises(ValueError, match="ps_placement"):
+        elastic.shard_placement(2, 2, "nope")
+    with pytest.raises(ValueError, match="num_shards"):
+        elastic.shard_placement(0, 2, "spread")
+
+
+def test_chaos_shard_filter_consumes_no_budget():
+    fault.inject_chaos("remote_ps.server.handle", "kill", shard=0, count=1)
+    # a follower shard's dispatches neither fire nor consume the budget
+    for _ in range(5):
+        assert fault.chaos("remote_ps.server.handle", shard=1) is None
+    act = fault.chaos("remote_ps.server.handle", shard=0)
+    assert act is not None and act.action == "kill"
+    assert fault.chaos("remote_ps.server.handle", shard=0) is None  # spent
+
+
+# -- lease handoff state machine ---------------------------------------------
+
+def test_double_promotion_rejected_and_stale_coordinator_fenced():
+    services = _fleet(2, standby=True, coord_lease_s=30.0)
+    coord, standby = services[0], services[-1]
+    try:
+        assert standby.is_standby and standby.standby is not None
+        # a live lease blocks promotion (the handoff needs the lapse)
+        did, reason = standby.standby.maybe_promote()
+        assert not did and "lease still live" in reason
+        did, reason = standby.standby.maybe_promote(force=True)
+        assert did and standby.standby.epoch == 1
+        # exactly one handoff: the second promotion is rejected
+        did, reason = standby.standby.maybe_promote(force=True)
+        assert not did and "double promotion rejected" in reason
+        assert standby.standby.epoch == 1
+        # the deposed coordinator hears the fence on its next heartbeat
+        assert not coord.fenced
+        coord.replicator.heartbeat()
+        assert coord.fenced
+        assert coord.fenced_by["epoch"] == 1
+        assert coord.fenced_by["coordinator"] == standby.advertised
+        # ... and refuses coordinator ops with a typed redirect
+        stale = RemoteParameterServer(coord.advertised, PARAMS, **FAST)
+        try:
+            with pytest.raises(CoordinatorFenced) as ei:
+                stale.pull()
+            assert ei.value.coordinator == standby.advertised
+            assert ei.value.epoch == 1
+        finally:
+            stale.close()
+        assert _counter("elastic.failover.promotions") == 1
+        assert _counter("elastic.failover.fenced") >= 1
+    finally:
+        _stop(services)
+
+
+def test_replicated_state_survives_promotion():
+    """The write-behind log is the promoted coordinator's state: a commit
+    the dead coordinator acked AND replicated is replayed on the standby
+    (clock intact), and the next commit continues the fold sequence."""
+    services = _fleet(2, standby=True, coord_lease_s=0.2)
+    one = _ones(PARAMS)
+    fleet = None
+    try:
+        fleet = _standby_client(services, **FAST)
+        first = fleet.commit_ex(one, last_update=0)
+        assert first == (0, 1.0)  # fresh clock: fold at 0, no staleness
+        # close the documented acked-but-unreplicated loss window
+        # deterministically, then kill the coordinator
+        assert services[0].replicator.flush(timeout=5.0)
+        services[0].kill(reason="drill")
+        # promotion is LAZY — the client's own re-resolution triggers it
+        # once the lease lapses
+        deadline = time.time() + 10.0
+        while True:
+            try:
+                if fleet.coordinator_view().get("promoted"):
+                    break
+            except (PSUnavailable, CoordinatorFenced):
+                pass
+            assert time.time() < deadline, "standby never promoted"
+            time.sleep(0.05)
+        assert services[-1].standby.promoted
+        assert services[-1].standby.applied >= 1
+        assert services[-1].standby.gaps == 0
+        assert fleet.num_updates == 1  # the replayed fold, not a reset
+        again = fleet.commit_ex(one, last_update=1)
+        assert again == (1, 1.0)  # the fold sequence continues at clock 1
+    finally:
+        if fleet is not None:
+            fleet.close()
+        _stop(services)
+
+
+# -- promotion numerics ------------------------------------------------------
+
+def test_promotion_preserves_dynsgd_fold_trajectory():
+    """The same sharded DynSGD commit schedule must produce the same
+    ``(at_fold, applied_weight)`` sequence and a BIT-IDENTICAL center
+    whether the coordinator survives or is killed mid-schedule with the
+    standby promoting via lease handoff. The replication log is flushed
+    before the kill, so no commit sits in the documented
+    acked-but-unreplicated loss window."""
+    ref_services = _fleet(2)
+    services = _fleet(2, standby=True, coord_lease_s=0.3)
+    one = _ones(PARAMS)
+    ref = fleet = None
+    # mixed-staleness schedule; the kill lands between the two halves
+    pre = (0, 0, 1, 0, 1, 0)
+    post = (2, 1, 4, 3, 5, 2)
+    try:
+        ref = ShardedRemoteParameterServer(
+            [svc.advertised for svc in ref_services], PARAMS, **FAST)
+        fleet = _standby_client(services, **FAST)
+        seq = [fleet.commit_ex(one, last_update=u) for u in pre]
+        # flush the write-behind log, kill the coordinator, then keep
+        # committing: the first post-kill commit retries until the lease
+        # lapses and the client re-resolves onto the promoted standby
+        assert services[0].replicator.flush(timeout=5.0)
+        services[0].kill(reason="drill")
+        deadline = time.time() + 10.0
+        while True:
+            try:
+                seq.append(fleet.commit_ex(one, last_update=post[0]))
+                break
+            except (PSUnavailable, CoordinatorFenced):
+                assert time.time() < deadline, \
+                    "client never re-resolved the coordinator"
+                time.sleep(0.05)
+        seq += [fleet.commit_ex(one, last_update=u) for u in post[1:]]
+        # the unkilled reference runs the identical schedule
+        ref_seq = [ref.commit_ex(one, last_update=u) for u in pre + post]
+        assert seq == ref_seq
+        # the promoted replica's center is bitwise the reference center
+        c_ref, clock_ref = ref.pull()
+        c_failover, clock_failover = fleet.pull()
+        assert clock_failover == clock_ref == len(ref_seq)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)), c_failover, c_ref)
+        assert services[-1].standby.promoted
+        assert services[-1].standby.gaps == 0  # replay saw every record
+        assert _counter("elastic.failover.promotions") == 1
+        assert _counter("elastic.failover.resolves") >= 1
+    finally:
+        if ref is not None:
+            ref.close()
+        if fleet is not None:
+            fleet.close()
+        _stop(ref_services)
+        _stop(services)
+
+
+# -- health plane follows the move -------------------------------------------
+
+def test_health_client_follows_coordinator_move():
+    services = _fleet(2, standby=True, coord_lease_s=0.25)
+    hc = None
+    try:
+        hc = HealthClient(services[0].advertised)
+        st = hc.status()
+        # the status digest advertises the re-resolution candidates
+        assert st["shard_addresses"] and st["standby"]
+        services[0].kill(reason="drill")
+        # the next poll re-resolves through the advertised candidates;
+        # until the lease lapses nobody has promoted, so keep polling —
+        # exactly what `health.cli watch` does
+        deadline = time.time() + 10.0
+        while True:
+            try:
+                st2 = hc.status()
+                break
+            except (OSError, RuntimeError):
+                assert time.time() < deadline, \
+                    "health client never re-resolved"
+                time.sleep(0.05)
+        assert hc.address == services[-1].advertised
+        assert st2["coord_epoch"] == 1
+        assert not st2.get("is_standby")  # promoted: no longer dark
+        assert _counter("elastic.failover.resolves") >= 1
+    finally:
+        if hc is not None:
+            hc.close()
+        _stop(services)
+
+
+# -- acceptance: chaos kill mid-run ------------------------------------------
+
+def _training_pieces(workers=2, window=2, batch=8, n=256):
+    from distkeras_tpu import DynSGD as DynSGDTrainer
+    from distkeras_tpu.data.dataset import synthetic_mnist
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.parallel import host_async
+
+    model = MLP(features=(8,), dropout_rate=0.0)
+    t = DynSGDTrainer(model, mode="host_async", num_workers=workers,
+                      worker_optimizer="sgd", learning_rate=0.05,
+                      metrics=(), batch_size=batch,
+                      communication_window=window)
+    params = model.init(jax.random.key(0), jnp.zeros((batch, 784)),
+                        train=False)["params"]
+    staged = host_async.stage_worker_shards(
+        synthetic_mnist(n=n).repartition(workers), "features", "label",
+        batch, window)
+    runner = host_async.HostAsyncRunner(
+        model, "categorical_crossentropy", t.tx, t.strategy, window=window,
+        max_degraded_windows=16)
+    return t, params, staged, runner
+
+
+def test_chaos_coordinator_kill_mid_run_fails_over(tmp_path):
+    """The acceptance run: a 2-worker DynSGD loop over a standby-backed
+    N=2 fleet whose COORDINATOR is chaos-killed mid-run under load. The
+    standby promotes via lease handoff, workers re-resolve and finish
+    with ZERO lost windows, and the dead coordinator's flight-recorder
+    postmortem carries the failover event."""
+    flight_recorder.configure(dump_dir=str(tmp_path))
+    t, params, staged, runner = _training_pieces()
+    # after=6 skips the registration/initial-pull handshake (2 registers
+    # + 2 coordinator pull legs + slack), so the kill lands on a live
+    # mid-run op — a commit or a lease renewal — with work in flight
+    fault.inject_chaos("remote_ps.server.handle", "kill",
+                       after=6, count=1, shard=0)
+    services = make_ps_fleet(
+        lambda part: DynSGDParameterServer(jax.device_put(part)),
+        params, 2, standby=True, coord_lease_s=0.3)
+    fleet = ShardedRemoteParameterServer(
+        [svc.advertised for svc in services if not svc.is_standby],
+        params, standby=services[-1].advertised,
+        retry=RetryPolicy(max_retries=2, base_s=0.01, max_s=0.05),
+        op_timeout=2.0)
+    try:
+        center, history, stal, clock = runner.run(
+            params, [staged] * 2, ps=fleet)
+        # zero lost windows: every scheduled window reached the merged
+        # history despite the coordinator dying under load
+        windows_total = 2 * sum(len(r) for r in staged)
+        assert len(runner.merged_windows) == windows_total
+        assert clock >= 1
+        assert services[-1].standby.promoted
+        assert _counter("elastic.failover.kills") == 1
+        assert _counter("elastic.failover.promotions") == 1
+        assert _counter("elastic.failover.resolves") >= 1
+        # the promoted coordinator's clock is the clock the run ended on
+        assert fleet.num_updates == clock
+        # the dead coordinator dumped a postmortem naming the failover
+        bundles = flight_recorder.find_bundles(str(tmp_path))
+        assert bundles, "coordinator kill must auto-dump a bundle"
+        killed = []
+        for path in bundles:
+            with open(path) as f:
+                bundle = json.load(f)
+            killed += [e for e in bundle.get("events", [])
+                       if e.get("kind") == "failover"
+                       and e.get("fields", {}).get("transition") == "killed"]
+        assert killed, "postmortem bundle must carry the failover event"
+    finally:
+        fault.clear_chaos()
+        fleet.close()
+        _stop(services)
