@@ -1,0 +1,333 @@
+"""Probe the live-rollout plane: swap latency, canary→promote,
+breach→rollback (DESIGN.md §18).
+
+Three legs against a CPU-safe MLP serving stack:
+
+- **swap**: continuous request traffic while the engine hot-swaps
+  weights many times — measures per-swap install latency, counts the
+  requests served during the churn, and ASSERTS zero failed requests
+  and a compile cache that never grew (zero recompiles);
+- **canary**: mirrored shadow traffic scores a staged copy against the
+  incumbent and promotes it — measures the stage→promote wall time;
+- **rollback**: a bad revision sneaks past a permissive local canary
+  gate, the canary-agreement SLO breaches, and ``on_breach``
+  auto-rolls-back to last-good — measures the breach→rollback wall
+  time and ASSERTS the restore is bit-identical, in-flight requests
+  all completed, and a postmortem bundle was dumped.
+
+Usage:
+  python benchmarks/rollout_probe.py [--swaps 20] [--rows 64]
+      [--out results/rollout_probe.jsonl]
+
+JSONL schema: one ``{"kind": "leg", "leg": "swap"|"canary"|"rollback",
+...}`` row per leg with its timings and the rollout counter totals,
+then one ``{"kind": "summary"}`` row with the headline numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+try:
+    import distkeras_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # running from a source checkout: use the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+FEATS = 12
+CLASSES = 4
+
+#: telemetry counters that tell the rollout story, in print order
+ROLLOUT_COUNTERS = (
+    "rollout.swaps",
+    "rollout.publishes",
+    "rollout.promotions",
+    "rollout.rejections",
+    "rollout.rollbacks",
+    "rollout.canary.evals",
+    "rollout.canary.mirrored",
+    "rollout.torn_swaps_blocked",
+    "serving.completed",
+)
+
+
+def _counter_totals(snapshot: dict) -> dict:
+    totals = {name: 0 for name in ROLLOUT_COUNTERS}
+    for key, value in snapshot["counters"].items():
+        base = key.split("{", 1)[0]
+        if base in totals:
+            totals[base] += int(value)
+    return totals
+
+
+def _stack(max_wait_ms: float = 2.0):
+    import jax
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.serving import ServingEngine
+
+    model = MLP(features=(16,), num_classes=CLASSES)
+    params = model.init(jax.random.key(0), jnp.zeros((2, FEATS)),
+                        train=False)["params"]
+    eng = ServingEngine(model, params, input_shape=(FEATS,),
+                        buckets=(8,), max_batch_size=8,
+                        max_wait_ms=max_wait_ms)
+    return model, params, eng
+
+
+def _rows(n, seed=0):
+    import numpy as np
+
+    return np.random.default_rng(seed).normal(size=(n, FEATS)) \
+        .astype(np.float32)
+
+
+def run_swap_leg(swaps: int = 20, rows: int = 64) -> dict:
+    """Hot-swap ``swaps`` times under continuous traffic; returns swap
+    latency stats and the requests served during the churn."""
+    import threading
+
+    import jax
+
+    from distkeras_tpu import telemetry
+
+    before = _counter_totals(telemetry.reset().snapshot())
+    _model, p_a, eng = _stack()
+    p_b = jax.tree.map(lambda a: a + 0.5, p_a)
+    try:
+        x = _rows(rows)
+        cache0 = eng.compiled_buckets
+        served = [0]
+        failed = [0]
+        stop = threading.Event()
+
+        def traffic():
+            while not stop.is_set():
+                futs = eng.submit_many(x[:8])
+                for f in futs:
+                    try:
+                        f.result(30)
+                        served[0] += 1
+                    except Exception:
+                        failed[0] += 1
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        lat = []
+        t0 = time.perf_counter()
+        for i in range(swaps):
+            s0 = time.perf_counter()
+            eng.swap_weights(p_b if i % 2 == 0 else p_a, i + 1)
+            lat.append(time.perf_counter() - s0)
+            time.sleep(0.005)
+        dt = time.perf_counter() - t0
+        stop.set()
+        t.join(30)
+        recompiled = eng.compiled_buckets != cache0
+    finally:
+        eng.shutdown()
+    snap = telemetry.get_registry().snapshot()
+    totals = _counter_totals(snap)
+    counters = {k: totals[k] - before.get(k, 0) for k in totals}
+    lat_sorted = sorted(lat)
+    return {"seconds": dt, "swaps": swaps,
+            "swap_p50_s": lat_sorted[len(lat) // 2],
+            "swap_max_s": lat_sorted[-1],
+            "served_during_churn": served[0], "failed": failed[0],
+            "recompiled": bool(recompiled),
+            "final_version": swaps, "counters": counters}
+
+
+def run_canary_leg(rows: int = 64) -> dict:
+    """Mirror shadow traffic, stage a copy, canary-score, promote."""
+    import jax
+    import numpy as np
+
+    from distkeras_tpu import telemetry
+    from distkeras_tpu.serving import CanaryConfig, RolloutController
+
+    before = _counter_totals(telemetry.reset().snapshot())
+    _model, p_a, eng = _stack()
+    try:
+        ctl = RolloutController(
+            engine=eng,
+            canary=CanaryConfig(fraction=1.0, min_rows=8, threshold=0.98))
+        x = _rows(rows, seed=1)
+        for f in eng.submit_many(x[:8]):
+            f.result(30)
+        deadline = time.time() + 10
+        while ctl.mirrored_rows() is None and time.time() < deadline:
+            time.sleep(0.01)
+        t0 = time.perf_counter()
+        ctl.stage(1, jax.tree.map(np.array, p_a))
+        score = ctl.evaluate_canary(rows=x)
+        dt = time.perf_counter() - t0
+        promoted = ctl.current_version == 1
+    finally:
+        eng.shutdown()
+    snap = telemetry.get_registry().snapshot()
+    totals = _counter_totals(snap)
+    counters = {k: totals[k] - before.get(k, 0) for k in totals}
+    return {"stage_to_promote_s": dt, "agreement": score,
+            "promoted": promoted, "counters": counters}
+
+
+def run_rollback_leg(rows: int = 64, dump_dir: str = None) -> dict:
+    """Bad revision past a permissive gate → SLO breach → auto-rollback.
+    Measures the breach→rollback wall time."""
+    import tempfile
+
+    import flax
+    import jax
+    import numpy as np
+
+    from distkeras_tpu import telemetry
+    from distkeras_tpu.health import recorder as flight_recorder
+    from distkeras_tpu.health.recorder import FlightRecorder, find_bundles
+    from distkeras_tpu.health.slo import (
+        SloEngine,
+        SloSpec,
+        rollout_on_breach,
+    )
+    from distkeras_tpu.serving import CanaryConfig, RolloutController
+
+    before = _counter_totals(telemetry.reset().snapshot())
+    flight_recorder.install(FlightRecorder())
+    if dump_dir is None:
+        dump_dir = tempfile.mkdtemp(prefix="rollout_probe_")
+    flight_recorder.configure(dump_dir=dump_dir)
+    _model, p_a, eng = _stack()
+    try:
+        ctl = RolloutController(
+            engine=eng,
+            canary=CanaryConfig(fraction=1.0, min_rows=8, threshold=0.2))
+        slo = SloEngine(
+            [SloSpec("canary-agreement", "rollout.canary.agreement",
+                     0.9, op=">=")],
+            on_breach=rollout_on_breach(ctl))
+        x = _rows(rows, seed=2)
+        ref = np.stack([f.result(30) for f in eng.submit_many(x[:8])])
+
+        # v1 good, v2 forced to the incumbent's most common class: its
+        # agreement clears the permissive 0.2 gate but breaches the 0.9
+        # SLO floor
+        ctl.stage(1, jax.tree.map(np.array, p_a))
+        ctl.evaluate_canary(rows=x)
+        slo.evaluate_once()  # agreement 1.0: records a clean verdict
+        inc = np.argmax(eng.shadow_forward(p_a, x), axis=-1)
+        cls = int(np.argmax(np.bincount(inc, minlength=CLASSES)))
+        flat = flax.traverse_util.flatten_dict(
+            jax.tree.map(np.array, p_a))
+        for k, v in flat.items():
+            if v.shape[-1] == CLASSES:
+                flat[k] = np.zeros_like(v)
+                if v.ndim == 1:
+                    flat[k][cls] = 100.0
+        bad = flax.traverse_util.unflatten_dict(flat)
+        ctl.stage(2, bad)
+        agreement = ctl.evaluate_canary(rows=x)
+        promoted_bad = ctl.current_version == 2
+
+        inflight = eng.submit_many(x[:8])
+        t0 = time.perf_counter()
+        alerts = slo.evaluate_once()
+        dt = time.perf_counter() - t0
+        rolled_back = ctl.current_version == 1
+        got = []
+        failed = 0
+        for f in inflight:
+            try:
+                got.append(f.result(30))
+            except Exception:
+                failed += 1
+        restored = np.stack([f.result(30)
+                             for f in eng.submit_many(x[:8])])
+        bit_identical = bool(np.array_equal(restored, ref))
+        bundles = find_bundles(dump_dir)
+    finally:
+        eng.shutdown()
+        flight_recorder.install(FlightRecorder())
+    snap = telemetry.get_registry().snapshot()
+    totals = _counter_totals(snap)
+    counters = {k: totals[k] - before.get(k, 0) for k in totals}
+    return {"breach_to_rollback_s": dt, "agreement": agreement,
+            "promoted_bad": promoted_bad, "rolled_back": rolled_back,
+            "breaches": len(alerts), "inflight_failed": failed,
+            "inflight_completed": len(got),
+            "bit_identical_restore": bit_identical,
+            "bundles": bundles, "counters": counters}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="swap latency, canary promotion, and SLO-driven "
+                    "rollback of the live-rollout plane (DESIGN.md §18)")
+    ap.add_argument("--swaps", type=int, default=20,
+                    help="hot-swaps in the churn leg")
+    ap.add_argument("--rows", type=int, default=64,
+                    help="traffic/shadow rows per leg")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="also write the legs as JSONL rows")
+    args = ap.parse_args(argv)
+
+    legs = [("swap", run_swap_leg(swaps=args.swaps, rows=args.rows)),
+            ("canary", run_canary_leg(rows=args.rows)),
+            ("rollback", run_rollback_leg(rows=args.rows))]
+    sw, ca, rb = (dict(legs)[k] for k in ("swap", "canary", "rollback"))
+    print(f"swap     : {sw['swaps']} swaps, p50 {sw['swap_p50_s']*1e3:.2f}ms "
+          f"max {sw['swap_max_s']*1e3:.2f}ms, "
+          f"{sw['served_during_churn']} requests served during churn, "
+          f"failed={sw['failed']}, recompiled={sw['recompiled']}")
+    print(f"canary   : agreement={ca['agreement']:.3f} "
+          f"promoted={ca['promoted']} "
+          f"stage→promote {ca['stage_to_promote_s']*1e3:.1f}ms")
+    print(f"rollback : agreement={rb['agreement']:.3f} "
+          f"promoted_bad={rb['promoted_bad']} "
+          f"breach→rollback {rb['breach_to_rollback_s']*1e3:.1f}ms, "
+          f"inflight_failed={rb['inflight_failed']}, "
+          f"bit_identical={rb['bit_identical_restore']}, "
+          f"bundles={len(rb['bundles'])}")
+    for leg, d in legs:
+        for name, value in d["counters"].items():
+            if value:
+                print(f"  [{leg}] {name}: {value}")
+
+    ok = True
+    if sw["failed"] or sw["recompiled"]:
+        print("FAIL: swap leg dropped requests or recompiled")
+        ok = False
+    if not ca["promoted"] or ca["agreement"] is None or ca["agreement"] < 0.98:
+        print("FAIL: canary leg did not promote the good revision")
+        ok = False
+    if not (rb["promoted_bad"] and rb["rolled_back"]
+            and rb["bit_identical_restore"] and rb["inflight_failed"] == 0
+            and rb["bundles"]):
+        print("FAIL: rollback leg did not auto-roll-back cleanly")
+        ok = False
+    if args.out:
+        rows = [{"kind": "leg", "leg": leg, "swaps": args.swaps,
+                 "rows": args.rows, **d} for leg, d in legs]
+        rows.append({"kind": "summary",
+                     "swap_p50_ms": sw["swap_p50_s"] * 1e3,
+                     "served_during_churn": sw["served_during_churn"],
+                     "canary_agreement": ca["agreement"],
+                     "breach_to_rollback_ms":
+                         rb["breach_to_rollback_s"] * 1e3,
+                     "inflight_failed": rb["inflight_failed"],
+                     "ok": ok})
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        print(f"wrote {args.out}")
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -13,7 +13,12 @@ from distkeras_tpu import telemetry
 from distkeras_tpu.precision import PRECISION_POLICIES, PrecisionPolicy
 from distkeras_tpu.utils.jax_compat import enable_compilation_cache
 from distkeras_tpu.data.dataset import Dataset, synthetic_mnist
-from distkeras_tpu.evaluators import AccuracyEvaluator, Evaluator, LossEvaluator
+from distkeras_tpu.evaluators import (
+    AccuracyEvaluator,
+    CanaryAgreementEvaluator,
+    Evaluator,
+    LossEvaluator,
+)
 from distkeras_tpu.predictors import ModelClassifier, ModelPredictor, Predictor
 from distkeras_tpu.serving import ServingEngine
 from distkeras_tpu.transformers import (
@@ -43,6 +48,7 @@ __all__ = [
     "ADAG",
     "AEASGD",
     "AccuracyEvaluator",
+    "CanaryAgreementEvaluator",
     "AveragingTrainer",
     "DOWNPOUR",
     "Dataset",

@@ -48,6 +48,7 @@ _CONSUMER_PATHS = (
     "benchmarks/health_probe.py",
     "benchmarks/attribution.py",
     "benchmarks/regression_gate.py",
+    "benchmarks/rollout_probe.py",
     "distkeras_tpu/health/export.py",
     "distkeras_tpu/health/endpoints.py",
     "distkeras_tpu/health/slo.py",

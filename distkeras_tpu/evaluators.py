@@ -69,6 +69,26 @@ class AccuracyEvaluator(Evaluator):
         return float(correct / total)
 
 
+class CanaryAgreementEvaluator(AccuracyEvaluator):
+    """Fraction of shadow rows where a canary version's predicted index
+    agrees with the incumbent's (serving/rollout.py, DESIGN.md §18).
+
+    Mechanically AccuracyEvaluator with the incumbent's outputs standing
+    in for labels: both columns go through the same argmax/threshold
+    decode, so logits, probabilities, and index columns all compare
+    correctly. Scoring agreement rather than ground-truth accuracy is
+    deliberate — shadow traffic has no labels at serve time, and "the new
+    version disagrees with the version users trusted" is exactly the
+    regression signal a canary exists to catch."""
+
+    def __init__(self, candidate_col: str = "candidate",
+                 incumbent_col: str = "incumbent",
+                 across_processes: bool = False):
+        super().__init__(prediction_col=candidate_col,
+                         label_col=incumbent_col,
+                         across_processes=across_processes)
+
+
 def _allgather_counts(value: float, total: float, integral: bool = False):
     """Sum (value, total) pairs over processes — the host-sharded
     aggregation primitive (a tiny collective; every process must call,

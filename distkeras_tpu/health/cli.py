@@ -78,8 +78,21 @@ def _fleet_alerts(rows: list) -> list:
     return out
 
 
+def _fleet_versions(rows: list) -> dict:
+    """{engine label: model_version} from the ``rollout.model_version``
+    gauges — the fleet version-skew view (one glance says whether every
+    engine is serving the same deployment, DESIGN.md §18)."""
+    out = {}
+    for r in rows:
+        if (r.get("kind") == "gauge"
+                and r.get("name") == "rollout.model_version"):
+            labels = r.get("labels") or {}
+            out[labels.get("engine", "?")] = int(r.get("value", 0))
+    return out
+
+
 def _watch_table(workers: dict, prev: dict, interval: float,
-                 fleet_alerts: list = ()) -> str:
+                 fleet_alerts: list = (), fleet_versions: dict = ()) -> str:
     cols = ("worker", "hb_age", "windows", "win/s", "staleness",
             "degraded", "alerts", "flag")
     lines = [time.strftime("%H:%M:%S") + "  " +
@@ -100,6 +113,10 @@ def _watch_table(workers: dict, prev: dict, interval: float,
         lines.append("          (no workers reporting yet)")
     if fleet_alerts:
         lines.append(f"          ALERTS: {', '.join(fleet_alerts)}")
+    if fleet_versions:
+        skew = " SKEW" if len(set(fleet_versions.values())) > 1 else ""
+        lines.append("          VERSIONS: " + ", ".join(
+            f"{k}=v{v}" for k, v in sorted(fleet_versions.items())) + skew)
     return "\n".join(lines)
 
 
@@ -115,7 +132,7 @@ def _watch_line(status: dict) -> str:
         f"watchdog={'TRIPPED' if status.get('watchdog_tripped') else 'ok'}",
         f"alerts={len(status.get('alerts', []) or [])}",
     ]
-    for key in ("clock", "queue_depth"):
+    for key in ("clock", "queue_depth", "model_version"):
         if key in status:
             parts.append(f"{key}={status[key]}")
     return "  ".join(parts)
@@ -226,7 +243,8 @@ def main(argv: Optional[list] = None) -> int:
                         print(_watch_table(
                             workers, prev_windows,
                             args.interval if n else 0.0,
-                            fleet_alerts=_fleet_alerts(rows)),
+                            fleet_alerts=_fleet_alerts(rows),
+                            fleet_versions=_fleet_versions(rows)),
                             flush=True)
                         prev_windows = {w: d.get("windows", 0)
                                         for w, d in workers.items()}

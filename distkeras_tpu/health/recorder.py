@@ -301,6 +301,8 @@ def merge_bundles(paths: List[str]) -> dict:
             "fingerprint": b.get("fingerprint", {}),
             "alerts": [e for e in b.get("events", [])
                        if e.get("kind") == "alert"],
+            "rollouts": [e for e in b.get("events", [])
+                         if e.get("kind") == "rollout"],
         } for b in bundles],
         "processes": sorted({b.get("process_index", 0) for b in bundles}),
         "last_trace_ids": trace_ids,
@@ -323,6 +325,11 @@ def render_timeline(merged: dict, limit: int = 60) -> str:
             f = alert.get("fields", {})
             out.append(f"    ALERT {f.get('slo', '?')}: "
                        f"{f.get('message', '')}")
+        for ev in b.get("rollouts", []):
+            f = ev.get("fields", {})
+            desc = " ".join(f"{k}={v}" for k, v in f.items()
+                            if k != "action")
+            out.append(f"    ROLLOUT {f.get('action', '?')}: {desc}")
     if merged.get("last_trace_ids"):
         out.append("last traces: " +
                    ", ".join(merged["last_trace_ids"][:8]))

@@ -279,7 +279,8 @@ def default_specs(mfu_floor: float = 0.50,
                   staleness_p95: float = 16.0,
                   ttft_p95_s: float = 2.0,
                   degraded_rate: float = 0.5,
-                  queue_depth: float = 512.0) -> List[SloSpec]:
+                  queue_depth: float = 512.0,
+                  canary_floor: float = 0.98) -> List[SloSpec]:
     """The stock objectives for a training+serving process; callers prune
     or reparameterize for their workload."""
     return [
@@ -294,6 +295,12 @@ def default_specs(mfu_floor: float = 0.50,
                 degraded_rate, op="<=", field="rate"),
         SloSpec("serving-queue", "serving.queue_depth", queue_depth,
                 op="<="),
+        # quality rail of the live-rollout plane (serving/rollout.py,
+        # DESIGN.md §18): a promoted version drifting from last-good on
+        # mirrored traffic pages — and with rollout_on_breach wired, the
+        # breach rolls the fleet back instead of raising
+        SloSpec("canary-agreement", "rollout.canary.agreement",
+                canary_floor, op=">=", severity="page"),
     ]
 
 
@@ -305,6 +312,24 @@ def watchdog_on_breach(watchdog) -> Callable[[AlertEvent], None]:
 
     def on_breach(alert: AlertEvent) -> None:
         watchdog.observe_slo_breach(alert)
+
+    return on_breach
+
+
+def rollout_on_breach(controller,
+                      chain: Optional[Callable[[AlertEvent], None]] = None
+                      ) -> Callable[[AlertEvent], None]:
+    """Adapt a :class:`~distkeras_tpu.serving.rollout.RolloutController`
+    into an ``on_breach`` callback: a breach swaps the fleet back to the
+    last-good version instead of raising, preserving the breach context
+    in a flight-recorder postmortem (DESIGN.md §18). ``chain`` (if given)
+    still sees every alert AFTER the rollback — page the human about the
+    rollback, don't page instead of rolling back."""
+
+    def on_breach(alert: AlertEvent) -> None:
+        controller.on_breach(alert)
+        if chain is not None:
+            chain(alert)
 
     return on_breach
 
@@ -334,6 +359,6 @@ def active_alerts() -> List[dict]:
 
 __all__ = [
     "SloSpec", "AlertEvent", "SloEngine", "OPS", "FIELDS",
-    "default_specs", "watchdog_on_breach",
+    "default_specs", "watchdog_on_breach", "rollout_on_breach",
     "install_engine", "get_engine", "active_alerts",
 ]

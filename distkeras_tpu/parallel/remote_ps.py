@@ -138,8 +138,8 @@ class CoordinatorFenced(RuntimeError):
 #: them until promoted. Discovery (shard_map/coordinator), replication,
 #: promotion, and the health plane stay served in both states.
 COORD_OPS = ("pull", "commit", "register", "lease_renew", "deregister",
-             "clock", "history_put", "history_get", "telemetry_put",
-             "telemetry_merged")
+             "clock", "version", "history_put", "history_get",
+             "telemetry_put", "telemetry_merged")
 
 
 def check_token(expected: Optional[str], header: dict) -> bool:
@@ -500,7 +500,11 @@ class ParameterServerService:
             return
         if op == "pull":
             center, clock = self.ps.pull()
-            self._reply(conn, op, {"clock": clock},
+            # model_version rides every pull reply so a rollout
+            # controller's poll is one roundtrip (serving/rollout.py)
+            self._reply(conn, op,
+                        {"clock": clock, "model_version":
+                         int(getattr(self.ps, "model_version", 0))},
                         codec.encode(center, kind="pull"))
         elif op == "commit":
             # idempotency check BEFORE decode: a retried commit (client
@@ -569,6 +573,19 @@ class ParameterServerService:
                 "addresses": list(self.shard_addresses or [])})
         elif op == "clock":
             self._reply(conn, op, {"clock": self.ps.pull()[1]})
+        elif op == "version":
+            # control-plane peek at the published deployment version
+            # (serving/rollout.py) without paying a center transfer;
+            # ``"set"`` stamps a publish (monotone, refused loudly)
+            if header.get("set") is not None:
+                try:
+                    self.ps.set_model_version(int(header["set"]))
+                except (AttributeError, ValueError) as e:
+                    _sendall(conn, {"error": str(e)})
+                    return
+            self._reply(conn, op, {
+                "version": int(getattr(self.ps, "model_version", 0)),
+                "clock": int(self.ps.num_updates)})
         elif op == "history_put":
             with self._hist_cv:
                 self._histories[int(header["pid"])] = header["windows"]
@@ -655,6 +672,7 @@ class ParameterServerService:
             self._reply(conn, op, handle_health_op(op, header, extra_status={
                 "service": "parameter_server",
                 "clock": int(self.ps.num_updates),  # no center fetch
+                "model_version": int(getattr(self.ps, "model_version", 0)),
                 "expected_processes": self.expected,
                 "histories_uploaded": uploaded,
                 "uptime_s": round(time.time() - self._t_start, 3),
@@ -1076,6 +1094,13 @@ class RemoteParameterServer:
         resp, blobs = self._roundtrip({"op": "pull"})
         return self.codec.decode(blobs, kind="pull"), resp["clock"]
 
+    def pull_versioned(self):
+        """(center, clock, model_version): the rollout controller's poll
+        primitive — one roundtrip, version stamped by the same reply."""
+        resp, blobs = self._roundtrip({"op": "pull"})
+        return (self.codec.decode(blobs, kind="pull"), resp["clock"],
+                int(resp.get("model_version", 0)))
+
     def commit(self, delta: Any, last_update: int = 0, **kw) -> int:
         return self.commit_ex(delta, last_update=last_update, **kw)[0]
 
@@ -1102,6 +1127,17 @@ class RemoteParameterServer:
     @property
     def num_updates(self) -> int:
         return self._control_roundtrip({"op": "clock"})["clock"]
+
+    @property
+    def model_version(self) -> int:
+        """The published deployment version (serving/rollout.py) — a
+        header-only control roundtrip, no center transfer."""
+        return int(self._control_roundtrip({"op": "version"})["version"])
+
+    def set_model_version(self, version: int) -> None:
+        """Stamp a publish onto the remote center (WeightPublisher's
+        remote leg); the server enforces monotonicity."""
+        self._control_roundtrip({"op": "version", "set": int(version)})
 
     # -- elastic membership (coordinator shard only; DESIGN.md §13) -------
     def register(self, worker: int,

@@ -38,6 +38,11 @@ class ParameterServer:
     def __init__(self, params: Any):
         self.center_variable = params
         self.num_updates = 0
+        # deployment counter, NOT the training clock: bumped by a
+        # WeightPublisher (serving/rollout.py) when a snapshot of this
+        # center is published for serving. Survives initialize() — a
+        # re-initialized center is new training, not a new deployment.
+        self.model_version = 0
         self._lock = threading.Lock()
 
     def initialize(self, params: Any) -> None:
@@ -52,6 +57,27 @@ class ParameterServer:
             out = self.center_variable, self.num_updates
         telemetry.counter("ps.pull.count").inc()
         return out
+
+    def pull_versioned(self):
+        """(center, clock, model_version) in one coherent read — the
+        rollout controller's poll primitive (serving/rollout.py)."""
+        with self._lock:
+            out = (self.center_variable, self.num_updates,
+                   self.model_version)
+        telemetry.counter("ps.pull.count").inc()
+        return out
+
+    def set_model_version(self, version: int) -> None:
+        """Stamp the published version onto the center. Monotone: a
+        lower-or-equal version is a publisher bug (two publishers racing,
+        or a clock walked backwards) and is refused loudly."""
+        version = int(version)
+        with self._lock:
+            if version <= self.model_version:
+                raise ValueError(
+                    f"model_version must be monotone: {version} <= "
+                    f"current {self.model_version}")
+            self.model_version = version
 
     def _note_commit(self, staleness: int, dur_s: float) -> None:
         """Commit bookkeeping, OUTSIDE the PS lock: a committer records its

@@ -39,10 +39,16 @@ from distkeras_tpu.serving.generation import (
     GenerationResult,
 )
 from distkeras_tpu.serving.kv_cache import KVCachePool
+from distkeras_tpu.serving.rollout import (
+    CanaryConfig,
+    RolloutController,
+    WeightPublisher,
+)
 from distkeras_tpu.serving.server import ServingClient, ServingServer
 
 __all__ = [
     "BucketSpec",
+    "CanaryConfig",
     "DEFAULT_BUCKETS",
     "DeadlineExceeded",
     "EngineClosed",
@@ -52,7 +58,9 @@ __all__ = [
     "QueueFull",
     "Request",
     "RequestQueue",
+    "RolloutController",
     "ServingClient",
     "ServingEngine",
     "ServingServer",
+    "WeightPublisher",
 ]

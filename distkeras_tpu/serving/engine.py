@@ -134,12 +134,20 @@ class ServingEngine:
                 forward,
                 in_shardings=(NamedSharding(mesh, P()), self._x_sharding),
                 out_shardings=self._x_sharding)
+            self._mesh, self._dev = mesh, None
             self.params = mesh_lib.put_replicated(params, mesh)
         else:
             dev = device if device is not None else jax.local_devices()[0]
             self._x_sharding = dev
             self._jit = lambda: jax.jit(forward)
+            self._mesh, self._dev = None, dev
             self.params = jax.device_put(params, dev)
+        # live-rollout state (serving/rollout.py, DESIGN.md §18): version
+        # of the installed params, swap coherence lock, optional shadow tap
+        self.model_version = 0
+        self.last_swap_time: Optional[float] = None
+        self.mirror_sink = None     # callable(np.ndarray rows) or None
+        self._swap_lock = threading.Lock()
 
         self._compiled: dict = {}          # bucket size -> AOT executable
         self._compile_lock = threading.Lock()
@@ -197,6 +205,77 @@ class ServingEngine:
         """The jit cache contents — after ``warmup()`` this is exactly the
         declared bucket ladder and never grows (asserted in tests)."""
         return tuple(sorted(self._compiled))
+
+    # -- live weight rollout (serving/rollout.py, DESIGN.md §18) ----------
+    def _place_params(self, params):
+        """Place a host/foreign tree the same way __init__ placed the
+        boot params, so swapped-in weights feed the SAME compiled
+        executables (identical shardings → zero recompile)."""
+        if self._mesh is not None:
+            from distkeras_tpu.parallel import mesh as mesh_lib
+
+            return mesh_lib.put_replicated(params, self._mesh)
+        return jax.device_put(params, self._dev)
+
+    def swap_weights(self, params, version: int) -> None:
+        """Atomically install ``params`` as ``version``. Validation
+        (treedef/shape/dtype against the incumbent) runs FIRST, so a torn
+        or mismatched tree raises ValueError with engine state untouched.
+        The device transfer completes before the swap lock is taken: the
+        batcher keeps serving the old version during the copy, and the
+        installation itself is one reference flip that ``_execute`` reads
+        exactly once per batch — every batch is entirely version N or
+        N+1, never a blend. No recompile: params are a runtime argument
+        to the AOT executables."""
+        from distkeras_tpu.serving.rollout import validate_tree_like
+
+        t0 = time.perf_counter()
+        try:
+            validate_tree_like(params, self.params)
+        except ValueError:
+            telemetry.counter("rollout.torn_swaps_blocked",
+                              engine="serving").inc()
+            raise
+        placed = self._place_params(params)
+        jax.block_until_ready(placed)
+        with self._swap_lock:
+            self.params = placed
+            self.model_version = int(version)
+            self.last_swap_time = time.time()
+        dt = time.perf_counter() - t0
+        telemetry.counter("rollout.swaps", engine="serving").inc()
+        telemetry.histogram("rollout.swap_s", engine="serving").record(dt)
+        telemetry.gauge("rollout.model_version", engine="serving").set(
+            int(version))
+        telemetry.gauge("rollout.last_swap_time",
+                        engine="serving").set(self.last_swap_time)
+        telemetry.record_event("rollout", action="swap", engine="serving",
+                               version=int(version), seconds=dt)
+        from distkeras_tpu.health import recorder as flight_recorder
+
+        flight_recorder.configure(serving_model_version=int(version))
+
+    def shadow_forward(self, params, rows: np.ndarray):
+        """Run ``rows`` through the ALREADY-COMPILED bucket executables
+        under arbitrary ``params`` (canary scoring: candidate vs
+        incumbent on mirrored traffic) without touching the live serving
+        path. Runs on the caller's thread — JAX dispatch is thread-safe
+        and the bucket ladder is warm, so this never compiles. Returns
+        the stacked first-output rows as a host array."""
+        rows = np.asarray(rows, dtype=self.input_dtype)
+        placed = self._place_params(params)
+        outs = []
+        for start in range(0, len(rows), self.max_batch_size):
+            chunk = rows[start:start + self.max_batch_size]
+            n = len(chunk)
+            bucket = self.spec.bucket_for(n)
+            x = np.zeros((bucket,) + self.input_shape, self.input_dtype)
+            x[:n] = chunk
+            fn = self._ensure_compiled(bucket)
+            y = fn(placed, jax.device_put(x, self._x_sharding))
+            outs.append(np.asarray(jax.tree.leaves(y)[0])[:n])
+        return np.concatenate(outs, axis=0) if outs else \
+            np.zeros((0,), self.input_dtype)
 
     # -- submission API ---------------------------------------------------
     def _make_request(self, x, timeout_ms, now: float) -> Request:
@@ -271,7 +350,12 @@ class ServingEngine:
                 # queue-wait ends here: execution is starting
                 telemetry.record_trace_span(req.trace, "trace.queue_wait",
                                             req.t_perf, t0 - req.t_perf)
-        y = fn(self.params, jax.device_put(x, self._x_sharding))
+        # one coherent (params, version) read per batch: the swap flips
+        # both under the same lock, so the version label below names the
+        # exact weights this batch computed on — never a blend
+        with self._swap_lock:
+            params, version = self.params, self.model_version
+        y = fn(params, jax.device_put(x, self._x_sharding))
         y_host = jax.tree.map(np.asarray, y)  # blocks until done
         dt = time.perf_counter() - t0
         self._execute_h.record(dt)
@@ -280,8 +364,17 @@ class ServingEngine:
                 # the batched forward serves every row at once: traced
                 # rows share the batch's compute interval
                 telemetry.record_trace_span(req.trace, "trace.compute",
-                                            t0, dt, bucket=bucket)
+                                            t0, dt, bucket=bucket,
+                                            model_version=version)
         self._batches.inc()
+        sink = self.mirror_sink
+        if sink is not None:
+            # shadow tap for canary scoring: live (unpadded) rows only.
+            # Copy — the staging buffer is reused by the next batch.
+            try:
+                sink(np.array(x[:n]))
+            except Exception:  # the canary must never break serving
+                telemetry.counter("rollout.mirror_errors").inc()
         now = time.monotonic()
         if isinstance(y_host, np.ndarray):  # the common single-output case:
             for i, req in enumerate(batch):  # row views, no per-row tree walk
@@ -314,6 +407,8 @@ class ServingEngine:
             "oldest_request_age_s": age,
             "queue_capacity": self._queue.capacity,
             "compiled_buckets": list(self.compiled_buckets),
+            "model_version": self.model_version,
+            "last_swap_time": self.last_swap_time,
             "shut": self._shut,
         }
 

@@ -219,7 +219,17 @@ class GenerationEngine:
                                 dtype=dtype, hbm_fraction=hbm_fraction)
         if device is not None:
             params = jax.device_put(params, device)
+        self._device = device
         self._params = params
+        # live-rollout state (serving/rollout.py, DESIGN.md §18): the
+        # scheduler thread owns installation; in-flight sequences finish
+        # on the version they started (pinned per slot at prefill), so
+        # several versions can be live at once until their slots retire
+        self.model_version = 0
+        self.last_swap_time: Optional[float] = None
+        self._versions = {0: params}       # version -> params (pinnable)
+        self._slot_version: dict = {}      # slot -> version pinned at prefill
+        self._pending_swap = None          # (version, params, Event, errbox)
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.eos_id = eos_id
         self.queue_capacity = int(queue_capacity)
@@ -307,6 +317,100 @@ class GenerationEngine:
         return {"prefill": tuple(sorted(self._prefill_exec)),
                 "decode": tuple(sorted(self._decode_exec))}
 
+    # -- live weight rollout (serving/rollout.py, DESIGN.md §18) -----------
+
+    def swap_weights(self, params, version: int,
+                     timeout: float = 60.0) -> None:
+        """Hand ``params`` to the scheduler thread as ``version`` and
+        block until installed. Validation runs on the caller's thread —
+        a torn tree raises ValueError with engine state untouched. The
+        scheduler applies the swap between iterations: requests prefilled
+        before it keep decoding on their pinned version (retire before
+        reclaim); requests admitted after it prefill on the new one. The
+        executables are shared across versions — the compile cache cannot
+        grow from a swap."""
+        import jax
+
+        from distkeras_tpu.serving.rollout import validate_tree_like
+
+        t0 = time.perf_counter()
+        try:
+            validate_tree_like(params, self._params)
+        except ValueError:
+            telemetry.counter("rollout.torn_swaps_blocked",
+                              engine="generation").inc()
+            raise
+        if self._device is not None:
+            params = jax.device_put(params, self._device)
+        jax.block_until_ready(params)
+        done = threading.Event()
+        errbox: list = []
+        with self._cv:
+            if self._closed:
+                raise EngineClosed("engine is shut down; no weight swaps")
+            if self._pending_swap is not None:
+                raise RuntimeError("a weight swap is already pending")
+            self._pending_swap = (int(version), params, done, errbox)
+            self._cv.notify_all()
+        if not done.wait(timeout):
+            raise TimeoutError(f"weight swap to version {version} not "
+                               f"applied within {timeout}s")
+        if errbox:
+            raise errbox[0]
+        dt = time.perf_counter() - t0
+        telemetry.counter("rollout.swaps", engine="generation").inc()
+        telemetry.histogram("rollout.swap_s", engine="generation").record(dt)
+        telemetry.record_event("rollout", action="swap",
+                               engine="generation", version=int(version),
+                               seconds=dt)
+
+    def _apply_pending_swap(self) -> None:
+        """Scheduler-thread half of :meth:`swap_weights`: install the
+        pending version as current between iterations. In-flight slots
+        keep their pinned entry in ``_versions`` until they retire."""
+        with self._cv:
+            pending = self._pending_swap
+            self._pending_swap = None
+        if pending is None:
+            return
+        version, params, done, _errbox = pending
+        self._params = params
+        self._versions[version] = params
+        self.model_version = version
+        self.last_swap_time = time.time()
+        telemetry.gauge("rollout.model_version",
+                        engine="generation").set(version)
+        telemetry.gauge("rollout.last_swap_time",
+                        engine="generation").set(self.last_swap_time)
+        from distkeras_tpu.health import recorder as flight_recorder
+
+        flight_recorder.configure(decode_model_version=int(version))
+        self._reclaim_versions()
+        done.set()
+
+    def _fail_pending_swap(self, err: Exception) -> None:
+        """Unblock a swapper whose swap can no longer be applied
+        (scheduler crash or shutdown) with ``err`` instead of a hang."""
+        with self._cv:
+            pending = self._pending_swap
+            self._pending_swap = None
+        if pending is not None:
+            _version, _params, done, errbox = pending
+            errbox.append(err)
+            done.set()
+
+    def _reclaim_versions(self) -> None:
+        """Retire-before-reclaim: drop params of versions no in-flight
+        slot pins and that are not current. Buffers release only after
+        the last sequence that started on them finished."""
+        pinned = set(self._slot_version.values())
+        pinned.add(self.model_version)
+        for stale in [v for v in self._versions if v not in pinned]:
+            del self._versions[stale]
+            telemetry.counter("rollout.versions_retired").inc()
+            telemetry.record_event("rollout", action="version_retired",
+                                   engine="generation", version=stale)
+
     # -- client API --------------------------------------------------------
 
     def generate(self, prompt, *, max_new_tokens: Optional[int] = None,
@@ -371,7 +475,8 @@ class GenerationEngine:
         try:
             while True:
                 with self._cv:
-                    while not self._dq and not active and not self._closed:
+                    while not self._dq and not active and not self._closed \
+                            and self._pending_swap is None:
                         self._cv.wait()
                     if self._closed and not self._drain:
                         pending = list(self._dq)
@@ -379,7 +484,10 @@ class GenerationEngine:
                         self._depth_g.set(0)
                         break
                     if self._closed and not self._dq and not active:
+                        self._fail_pending_swap(EngineClosed(
+                            "engine is shut down; no weight swaps"))
                         return
+                self._apply_pending_swap()
                 self._admit(active)
                 self._expire(active)
                 if active:
@@ -395,17 +503,21 @@ class GenerationEngine:
                 self._dq.clear()
                 self._depth_g.set(0)
             err = EngineClosed(f"generation scheduler failed: {e!r}")
+            self._fail_pending_swap(err)
             for req in pending + list(active.values()):
                 req.future.set_exception(err)
             for slot in list(active):
                 self.pool.free(slot)
+            self._slot_version.clear()
             raise
         # non-draining shutdown: fail everything still in flight
         err = EngineClosed("engine shut down without draining")
+        self._fail_pending_swap(err)
         for req in pending + list(active.values()):
             req.future.set_exception(err)
         for slot in list(active):
             self.pool.free(slot)
+        self._slot_version.clear()
         self._active_g.set(0)
 
     def _admit(self, active) -> None:
@@ -444,6 +556,10 @@ class GenerationEngine:
         tp0 = time.perf_counter()
         new_pool, logits = self._prefill_exec[lb](
             self._params, self.pool.pool, ids, np.int32(slot), np.int32(n))
+        # pin the version this sequence started on: every later decode
+        # step for this slot runs on the SAME params even if a swap lands
+        # mid-generation (in-flight requests provably finish on it)
+        self._slot_version[slot] = self.model_version
         self.pool.swap(new_pool)
         self.pool.lengths[slot] = n
         tok = int(np.argmax(np.asarray(logits)))
@@ -454,13 +570,36 @@ class GenerationEngine:
         if req.trace is not None:
             telemetry.record_trace_span(
                 req.trace, "trace.prefill", tp0,
-                time.perf_counter() - tp0, bucket=lb, slot=slot)
+                time.perf_counter() - tp0, bucket=lb, slot=slot,
+                model_version=self.model_version)
         req.generated.append(tok)
         req.last_token = tok
         self._stream_token(req, tok)
 
     def _decode_step(self, active) -> None:
-        slots = sorted(active)
+        """One scheduler iteration of decode. Slots are grouped BY PINNED
+        VERSION and each group runs its own ladder call: a single decode
+        executable call shares one params argument across its lanes, so a
+        mixed-version call is structurally impossible — grouping is what
+        makes "finish on the version you started" hold mid-rollout. The
+        groups reuse the SAME ladder executables (params are a runtime
+        argument), so the compile cache cannot grow. Steady state is one
+        group — the multi-group step exists only for the swap window."""
+        groups: dict = {}
+        for s in sorted(active):
+            groups.setdefault(
+                self._slot_version.get(s, self.model_version),
+                []).append(s)
+        if len(groups) > 1:
+            telemetry.histogram("rollout.version_groups").record(
+                len(groups))
+        for version in sorted(groups):
+            self._decode_group(active, groups[version], version)
+        self._reclaim_versions()
+        self._active_g.set(len(active))
+
+    def _decode_group(self, active, slots, version: int) -> None:
+        params = self._versions.get(version, self._params)
         n = len(slots)
         lane = self._ladder.bucket_for(n)
         scratch = self.pool.scratch_slot
@@ -474,7 +613,7 @@ class GenerationEngine:
         t0 = time.monotonic()
         tp0 = time.perf_counter()
         new_pool, logits = self._decode_exec[lane](
-            self._params, self.pool.pool, slot_ids, tokens, lengths)
+            params, self.pool.pool, slot_ids, tokens, lengths)
         self.pool.swap(new_pool)
         logits = np.asarray(logits)  # blocks until the step lands
         dt = time.monotonic() - t0
@@ -498,12 +637,12 @@ class GenerationEngine:
                 # be an invention, not a measurement
                 telemetry.record_trace_span(
                     req.trace, "trace.decode", tp0, dt_p,
-                    step=len(req.generated), lanes=lane)
+                    step=len(req.generated), lanes=lane,
+                    model_version=version)
             self._stream_token(req, tok)
             reason = self._emit(req, s)
             if reason is not None:
                 del active[s]
-        self._active_g.set(len(active))
 
     def _emit(self, req: _GenRequest, slot: int) -> Optional[str]:
         """After a token lands, decide retirement. Returns the reason
@@ -519,6 +658,7 @@ class GenerationEngine:
         else:
             return None
         self.pool.free(slot)
+        self._slot_version.pop(slot, None)  # unpin: version may reclaim
         telemetry.counter("serving.decode.retired", reason=reason).inc()
         if req.trace is not None:
             telemetry.record_trace_span(
@@ -538,6 +678,7 @@ class GenerationEngine:
             if req.deadline is not None and now > req.deadline:
                 del active[slot]
                 self.pool.free(slot)
+                self._slot_version.pop(slot, None)
                 self._expired_c.inc()
                 telemetry.counter("serving.decode.retired",
                                   reason="deadline").inc()
@@ -579,6 +720,9 @@ class GenerationEngine:
             "decode_ladder": list(self._ladder.sizes),
             "compiled": {k: list(v) for k, v in
                          self.compiled_executables.items()},
+            "model_version": self.model_version,
+            "last_swap_time": self.last_swap_time,
+            "live_versions": sorted(self._versions),
         }
 
     def shutdown(self, drain: bool = True, timeout: float = 60.0) -> None:
@@ -595,6 +739,7 @@ class GenerationEngine:
                 self._depth_g.set(0)
             err = EngineClosed(
                 f"scheduler still running after {timeout}s shutdown join")
+            self._fail_pending_swap(err)
             for req in pending:
                 req.future.set_exception(err)
 

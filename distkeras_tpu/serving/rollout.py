@@ -1,0 +1,456 @@
+"""Live rollout: versioned weight hot-swap, canary scoring, rollback.
+
+Closes the train→serve loop (ROADMAP item 3, DESIGN.md §18): a trainer
+publishes monotone-versioned weight snapshots through a
+:class:`WeightPublisher`, and a :class:`RolloutController` on the serving
+side installs them into the already-compiled executables of
+``ServingEngine``/``GenerationEngine`` with **zero recompile** — params
+are a runtime argument to every AOT executable, so a swap is a validated
+reference flip, never a retrace.
+
+The safety ladder, bottom to top:
+
+- **Swap atomicity** — :func:`validate_tree_like` refuses any candidate
+  whose treedef/shapes/dtypes differ from the incumbent (a torn or
+  half-serialized publish can never be installed), and each engine's
+  ``swap_weights`` installs the whole tree in one reference assignment
+  that request execution reads exactly once per batch/step.
+- **Canary** — a staged version first serves a configurable fraction of
+  mirrored shadow traffic; ``evaluators.CanaryAgreementEvaluator`` scores
+  its outputs against the incumbent's and only agreement >= threshold
+  promotes.
+- **Rollback** — :meth:`RolloutController.on_breach` plugs into the SLO
+  engine's ``on_breach`` seam (health/slo.py): instead of raising, a
+  breach swaps back to the retained last-good version (bit-identical
+  restore) and dumps a flight-recorder postmortem bundle carrying the
+  breach context and both version fingerprints.
+
+Nothing here imports the engines at module level — the controller is
+duck-typed against ``swap_weights``/``model_version``/``shadow_forward``
+so it composes with either engine (or both) and stays import-light.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from distkeras_tpu import telemetry
+
+logger = logging.getLogger("distkeras_tpu.serving.rollout")
+
+
+def validate_tree_like(new, like) -> None:
+    """Refuse a candidate pytree that is not drop-in compatible with the
+    incumbent: same treedef, and per-leaf same shape and dtype. This is
+    the swap-atomicity gate (DESIGN.md §18) — a torn publish (truncated
+    blobs, half-serialized tree) fails here BEFORE any engine state is
+    touched, so a half-installed pytree can never serve. Raises
+    ValueError with the first mismatch; returns None when compatible."""
+    import jax
+
+    new_leaves, new_def = jax.tree.flatten(new)
+    like_leaves, like_def = jax.tree.flatten(like)
+    if new_def != like_def:
+        raise ValueError(
+            f"weight swap rejected: tree structure mismatch "
+            f"(candidate {new_def} vs incumbent {like_def})")
+    for i, (a, b) in enumerate(zip(new_leaves, like_leaves)):
+        a_shape, b_shape = tuple(np.shape(a)), tuple(np.shape(b))
+        if a_shape != b_shape:
+            raise ValueError(
+                f"weight swap rejected: leaf {i} shape {a_shape} != "
+                f"incumbent {b_shape} (torn or mismatched publish)")
+        a_dt = np.asarray(a).dtype if not hasattr(a, "dtype") else a.dtype
+        b_dt = np.asarray(b).dtype if not hasattr(b, "dtype") else b.dtype
+        if np.dtype(a_dt) != np.dtype(b_dt):
+            raise ValueError(
+                f"weight swap rejected: leaf {i} dtype {a_dt} != "
+                f"incumbent {b_dt}")
+
+
+def _torn_copy(tree):
+    """A structurally-valid but shape-torn copy of ``tree`` (every other
+    leaf replaced by an empty array): what a half-serialized publish looks
+    like after decode. Engine-side validation MUST refuse it."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    torn = [np.zeros(0, np.asarray(leaf).dtype) if i % 2 else leaf
+            for i, leaf in enumerate(leaves)]
+    return jax.tree.unflatten(treedef, torn)
+
+
+class WeightPublisher:
+    """Trainer-side half of the rollout plane: stamps a monotone
+    ``model_version`` onto weight snapshots and hands them to
+    subscribers (in-process controllers) and/or the parameter server
+    (``ps.set_model_version`` — remote controllers then see the version
+    on their next pull).
+
+    The publish path is a chaos site (``"rollout.publish"``,
+    utils/fault.py): ``drop`` loses the publish (version not bumped),
+    ``delay`` stalls it, ``torn`` delivers a half-serialized tree that
+    subscriber-side validation must refuse.
+    """
+
+    def __init__(self, ps=None, start_version: int = 0):
+        self.ps = ps
+        self.version = int(start_version)
+        self._subscribers: list[Callable] = []
+        self._lock = threading.Lock()
+
+    def subscribe(self, callback: Callable) -> None:
+        """Register ``callback(version, params, clock)`` for each publish."""
+        self._subscribers.append(callback)
+
+    def publish(self, params=None, clock=None) -> Optional[int]:
+        """Publish a snapshot as the next version. ``params=None`` pulls
+        the live center from ``self.ps``. Returns the published version,
+        or None when chaos dropped the publish."""
+        from distkeras_tpu.utils import fault
+
+        act = fault.chaos("rollout.publish")
+        if act is not None and act.action == "drop":
+            telemetry.counter("rollout.publish_dropped").inc()
+            logger.warning("weight publish dropped by chaos injection")
+            return None
+        if act is not None and act.action == "delay":
+            time.sleep(act.delay_s)
+        if params is None:
+            if self.ps is None:
+                raise ValueError("publish(params=None) needs a ps to "
+                                 "snapshot the center from")
+            params, pulled_clock = self.ps.pull()
+            if clock is None:
+                clock = pulled_clock
+        if act is not None and act.action == "torn":
+            # half-serialized delivery: structurally valid, leaf shapes
+            # wrong — every subscriber's swap validation must refuse it
+            params = _torn_copy(params)
+        with self._lock:
+            self.version += 1
+            version = self.version
+        if self.ps is not None:
+            self.ps.set_model_version(version)
+        telemetry.counter("rollout.publishes").inc()
+        telemetry.record_event("rollout", action="publish",
+                               version=version, clock=clock)
+        for cb in list(self._subscribers):
+            cb(version, params, clock)
+        return version
+
+
+class CanaryConfig:
+    """How a staged version must prove itself before promotion.
+
+    ``fraction`` of served batches are mirrored into a shadow buffer
+    (deterministic accumulator, not sampling — reproducible under test);
+    once ``min_rows`` mirrored rows have been scored, agreement between
+    candidate and incumbent outputs (``evaluator``, default
+    ``CanaryAgreementEvaluator``) must reach ``threshold`` to promote.
+    """
+
+    def __init__(self, fraction: float = 0.25, min_rows: int = 32,
+                 threshold: float = 0.98, evaluator=None,
+                 max_mirror_rows: int = 512):
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError(f"fraction must be in (0, 1], got {fraction}")
+        self.fraction = float(fraction)
+        self.min_rows = int(min_rows)
+        self.threshold = float(threshold)
+        if evaluator is None:
+            from distkeras_tpu.evaluators import CanaryAgreementEvaluator
+
+            evaluator = CanaryAgreementEvaluator()
+        self.evaluator = evaluator
+        self.max_mirror_rows = int(max_mirror_rows)
+
+
+class RolloutController:
+    """Serving-side half: receives/pulls versions, canaries them against
+    the incumbent on mirrored traffic, promotes on pass, and rolls back
+    to the retained last-good version on SLO breach.
+
+    ``engine`` (ServingEngine) and/or ``generator`` (GenerationEngine)
+    are the swap targets; ``source`` is an optional versioned pull source
+    (a ParameterServer or RemoteParameterServer) for :meth:`poll`.
+    ``canary=None`` promotes every staged version immediately (still
+    validated, still retaining last-good for rollback).
+    """
+
+    def __init__(self, engine=None, generator=None, source=None,
+                 canary: Optional[CanaryConfig] = None):
+        if engine is None and generator is None:
+            raise ValueError("RolloutController needs at least one of "
+                             "engine= or generator=")
+        self.engine = engine
+        self.generator = generator
+        self.source = source
+        self.canary = canary
+        self._lock = threading.Lock()
+        primary = engine if engine is not None else generator
+        self.current_version = int(getattr(primary, "model_version", 0))
+        self.current_params = engine.params if engine is not None \
+            else generator._params
+        # last-good retained for rollback (starts empty: the boot version
+        # has nothing earlier to fall back to)
+        self.last_good_version: Optional[int] = None
+        self.last_good_params = None
+        # staged candidate awaiting canary verdict
+        self.candidate_version: Optional[int] = None
+        self.candidate_params = None
+        self._mirror = collections.deque(
+            maxlen=canary.max_mirror_rows if canary else 0)
+        self._acc = 0.0
+        self.last_agreement: Optional[float] = None
+        if canary is not None and engine is not None:
+            engine.mirror_sink = self._tap
+
+    # -- mirrored shadow traffic ------------------------------------------
+
+    def _tap(self, rows: np.ndarray) -> None:
+        """Mirror sink installed on the serving engine: keeps a
+        deterministic ``fraction`` of served batches for shadow scoring.
+        Runs on the batcher thread — must never raise (the engine guards
+        it anyway) and never touches engine state."""
+        if self.canary is None:
+            return
+        self._acc += self.canary.fraction
+        if self._acc < 1.0:
+            return
+        self._acc -= 1.0
+        with self._lock:
+            self._mirror.append(np.asarray(rows))
+        telemetry.counter("rollout.canary.mirrored").inc(len(rows))
+
+    def mirrored_rows(self) -> Optional[np.ndarray]:
+        with self._lock:
+            if not self._mirror:
+                return None
+            return np.concatenate(list(self._mirror), axis=0)
+
+    # -- staging / promotion ----------------------------------------------
+
+    def stage(self, version: int, params) -> bool:
+        """Receive a published version. Non-monotone versions are refused
+        (counter ``rollout.stale_publishes``); with no canary configured
+        the version promotes immediately; otherwise it waits as candidate
+        until :meth:`evaluate_canary` passes. Validation happens at
+        install time inside the engines' ``swap_weights`` — a torn tree
+        is refused there and never becomes candidate-current."""
+        version = int(version)
+        with self._lock:
+            if version <= self.current_version:
+                telemetry.counter("rollout.stale_publishes").inc()
+                telemetry.record_event("rollout", action="stale_publish",
+                                       version=version,
+                                       current=self.current_version)
+                return False
+        if self.canary is None:
+            return self.promote(version, params)
+        # validate EAGERLY so a torn publish is refused at staging time,
+        # not after it has shadow-served
+        try:
+            validate_tree_like(params, self.current_params)
+        except ValueError:
+            telemetry.counter("rollout.torn_swaps_blocked",
+                              engine="controller").inc()
+            telemetry.record_event("rollout", action="torn_stage_blocked",
+                                   version=version)
+            logger.warning("staged version %d refused: incompatible tree",
+                           version)
+            return False
+        with self._lock:
+            self.candidate_version = version
+            self.candidate_params = params
+            self.last_agreement = None
+        telemetry.record_event("rollout", action="stage", version=version)
+        return True
+
+    def poll(self) -> bool:
+        """Pull the source once; stage when it advertises a newer version.
+        Returns True when something was staged/promoted."""
+        if self.source is None:
+            raise ValueError("poll() needs a source= pull target")
+        if hasattr(self.source, "pull_versioned"):
+            params, _clock, version = self.source.pull_versioned()
+        else:
+            params, _clock = self.source.pull()
+            version = int(getattr(self.source, "model_version", 0))
+        if version <= self.current_version:
+            return False
+        return self.stage(version, params)
+
+    def evaluate_canary(self, rows: Optional[np.ndarray] = None) -> Optional[float]:
+        """Score the staged candidate against the incumbent on mirrored
+        shadow rows (or explicit ``rows``). Promotes on pass; discards
+        the candidate on fail. Returns the agreement score, or None when
+        there is nothing to score yet. Requires ``engine`` (the dense
+        engine owns ``shadow_forward``)."""
+        with self._lock:
+            candidate_version = self.candidate_version
+            candidate_params = self.candidate_params
+        if candidate_version is None:
+            return None
+        if self.engine is None:
+            raise ValueError("canary scoring needs the dense engine= "
+                             "(shadow_forward lives there)")
+        if rows is None:
+            rows = self.mirrored_rows()
+        if rows is None or len(rows) < (self.canary.min_rows
+                                        if self.canary else 1):
+            return None
+        cand = self.engine.shadow_forward(candidate_params, rows)
+        incumbent = self.engine.shadow_forward(self.current_params, rows)
+        score = float(self.canary.evaluator.evaluate(
+            {"candidate": cand, "incumbent": incumbent}))
+        with self._lock:
+            self.last_agreement = score
+        telemetry.counter("rollout.canary.evals").inc()
+        telemetry.gauge("rollout.canary.agreement").set(score)
+        telemetry.record_event("rollout", action="canary_eval",
+                               version=candidate_version, agreement=score,
+                               rows=int(len(rows)))
+        if score >= (self.canary.threshold if self.canary else 0.0):
+            self.promote(candidate_version, candidate_params)
+        else:
+            with self._lock:
+                self.candidate_version = None
+                self.candidate_params = None
+            telemetry.counter("rollout.rejections").inc()
+            telemetry.record_event("rollout", action="canary_reject",
+                                   version=candidate_version,
+                                   agreement=score)
+            logger.warning("canary version %d rejected: agreement %.4f "
+                           "< %.4f", candidate_version, score,
+                           self.canary.threshold if self.canary else 0.0)
+        return score
+
+    def promote(self, version: int, params) -> bool:
+        """Install ``params`` as ``version`` on every engine, retaining
+        the incumbent as last-good. Installation is all-or-nothing at the
+        controller level: validation runs against the dense engine first,
+        so a refused tree never reaches the generator either."""
+        version = int(version)
+        try:
+            self._install(version, params)
+        except ValueError:
+            # torn/incompatible tree: engines refused, nothing installed
+            return False
+        with self._lock:
+            self.last_good_version = self.current_version
+            self.last_good_params = self.current_params
+            self.current_version = version
+            self.current_params = params
+            if self.candidate_version == version:
+                self.candidate_version = None
+                self.candidate_params = None
+        telemetry.counter("rollout.promotions").inc()
+        telemetry.record_event("rollout", action="promote", version=version,
+                               previous=self.last_good_version)
+        return True
+
+    def _install(self, version: int, params) -> None:
+        """Swap both engines to (version, params). The dense engine goes
+        first (its validation is synchronous and cheap); a refusal there
+        aborts before the generator is touched, so the fleet never splits
+        across an invalid tree."""
+        t0 = time.perf_counter()
+        if self.engine is not None:
+            self.engine.swap_weights(params, version)
+        if self.generator is not None:
+            self.generator.swap_weights(params, version)
+        telemetry.histogram("rollout.swap_s").record(
+            time.perf_counter() - t0)
+        from distkeras_tpu.health import recorder as flight_recorder
+
+        flight_recorder.configure(serving_model_version=int(version))
+
+    # -- rollback ----------------------------------------------------------
+
+    def rollback(self, alert=None) -> bool:
+        """Swap back to the retained last-good version (bit-identical
+        restore — the exact tree object that served before promotion).
+        A pending candidate is discarded first (a canary breach must not
+        promote later). Idempotent: a second rollback with nothing newer
+        installed is a no-op. Returns True when a swap happened."""
+        with self._lock:
+            candidate = self.candidate_version
+            self.candidate_version = None
+            self.candidate_params = None
+            from_version = self.current_version
+            to_version = self.last_good_version
+            to_params = self.last_good_params
+        if candidate is not None:
+            telemetry.counter("rollout.rejections").inc()
+            telemetry.record_event("rollout", action="candidate_discarded",
+                                   version=candidate)
+        if to_version is None or to_params is None \
+                or to_version == from_version:
+            telemetry.record_event("rollout", action="rollback_noop",
+                                   current=from_version)
+            return False
+        self._install(to_version, to_params)
+        with self._lock:
+            self.current_version = to_version
+            self.current_params = to_params
+            # last-good stays as-is: rolling back twice is a no-op, not a
+            # walk further into history
+        telemetry.counter("rollout.rollbacks").inc()
+        from distkeras_tpu.health import recorder as flight_recorder
+
+        rec = flight_recorder.get_recorder()
+        rec.record("rollout", action="rollback",
+                   from_version=from_version, to_version=to_version,
+                   slo=getattr(alert, "slo", None),
+                   message=getattr(alert, "message", None))
+        rec.set_fingerprint(serving_model_version=int(to_version),
+                            rollback_from_version=int(from_version))
+        telemetry.record_event("rollout", action="rollback",
+                               from_version=from_version,
+                               to_version=to_version,
+                               slo=getattr(alert, "slo", None))
+        logger.warning("rolled back %d -> %d (%s)", from_version,
+                       to_version, getattr(alert, "slo", "manual"))
+        return True
+
+    def on_breach(self, alert) -> None:
+        """SLO ``on_breach`` hook (health/slo.py): roll back instead of
+        raising, preserving the breach's forensic context in a postmortem
+        bundle. NEVER raises — a broken rollback path must not take down
+        the SLO evaluation loop with it."""
+        try:
+            from distkeras_tpu.health import recorder as flight_recorder
+
+            swapped = self.rollback(alert)
+            reason = "rollout_rollback" if swapped else "canary_breach"
+            flight_recorder.auto_dump(reason)
+        except Exception:  # pragma: no cover - forensics must not raise
+            logger.exception("rollback on SLO breach failed")
+
+    # -- introspection -----------------------------------------------------
+
+    def status(self) -> dict:
+        """JSON-safe controller state for health digests."""
+        with self._lock:
+            return {
+                "current_version": self.current_version,
+                "last_good_version": self.last_good_version,
+                "candidate_version": self.candidate_version,
+                "last_agreement": self.last_agreement,
+                "mirror_rows": int(sum(len(r) for r in self._mirror)),
+            }
+
+
+__all__ = [
+    "CanaryConfig",
+    "RolloutController",
+    "WeightPublisher",
+    "validate_tree_like",
+]

@@ -16,6 +16,11 @@ Protocol (header JSON + raw blobs, see remote_ps):
     {"op": "stats", "token": ...} -> {"counters": {...}, "gauges": {...}}
     {"op": "ping", "token": ...}  -> {"ok": true}
 
+    {"op": "weights_put", "token": ..., "version": v,
+     "target": "serving|generation|both"} + blobs: _TreeCodec leaves
+    -> {"ok": ..., "version": v, "staged": ...}   (live rollout, §18)
+    {"op": "version", "token": ...} -> {"model_version": v, ...}
+
     {"op": "generate", "token": ..., "length": n, "max_new_tokens": m,
      "timeout_ms": ..., "eos_id": ...} + blob: int32 prompt tokens
     -> zero or more {"stream": true, "tokens": [...]} frames (one per
@@ -88,11 +93,15 @@ class ServingServer:
 
     def __init__(self, engine: ServingEngine, host: str = "0.0.0.0",
                  port: int = 0, token: Optional[str] = None,
-                 generator=None):
+                 generator=None, rollout=None):
         self.engine = engine
         #: optional GenerationEngine backing the ``generate`` op; None
         #: keeps this a pure one-shot inference server
         self.generator = generator
+        #: optional RolloutController (serving/rollout.py): when mounted,
+        #: ``weights_put`` stages through it (canary + rollback rails)
+        #: instead of swapping the engines directly
+        self.rollout = rollout
         self.token = token
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -172,6 +181,16 @@ class ServingServer:
                 # sees exactly one typed final frame
                 send_message(conn, {"error": str(e),
                                     "kind": _error_kind(e)})
+        elif op == "weights_put":
+            # live rollout (serving/rollout.py, DESIGN.md §18): install a
+            # published version over the wire — zero restart, zero recompile
+            try:
+                send_message(conn, self._weights_put(header, blobs))
+            except Exception as e:
+                send_message(conn, {"error": str(e),
+                                    "kind": _error_kind(e)})
+        elif op == "version":
+            send_message(conn, self._version())
         elif op == "stats":
             send_message(conn, self._stats())
         elif op == "ping":
@@ -193,12 +212,62 @@ class ServingServer:
                                 "kind": "bad_request"})
 
     @staticmethod
-    def _request_trace(header: dict):
+    def _request_trace(header: dict, engine=None):
         """One trace per request (DESIGN.md §15): adopt the caller's wire
         context when the header carries one, else mint a fresh root — so
-        a serving request is traceable whether or not the client traces."""
+        a serving request is traceable whether or not the client traces.
+        The serving model version rides the baggage (without clobbering a
+        caller-set value), so per-version latency/quality attribution
+        falls out of the existing trace plane."""
         ctx = telemetry.extract(header)
-        return telemetry.TraceContext.new_root() if ctx is None else ctx
+        if ctx is None:
+            ctx = telemetry.TraceContext.new_root()
+        if engine is not None:
+            ctx.baggage.setdefault("model_version",
+                                   str(engine.model_version))
+        return ctx
+
+    def _weights_put(self, header: dict, blobs: list) -> dict:
+        """Decode a published weight tree and install it. Routed through
+        the mounted RolloutController (canary/rollback rails) when one
+        exists; a direct engine swap otherwise. The blob layout rides the
+        same ``_TreeCodec`` framing the PS wire uses; a torn blob list
+        fails decode or swap validation — it can never half-install."""
+        from distkeras_tpu.parallel.remote_ps import _TreeCodec
+
+        version = int(header["version"])
+        target = header.get("target", "serving")
+        if target not in ("serving", "generation", "both"):
+            raise ValueError(f"unknown weights_put target {target!r}")
+        if target != "serving" and self.generator is None:
+            raise ValueError("no generation engine mounted on this server")
+        template = self.engine.params if target == "serving" \
+            else self.generator._params
+        tree = _TreeCodec(template).decode(blobs, kind="pull")
+        if self.rollout is not None:
+            ok = self.rollout.stage(version, tree)
+            return {"ok": bool(ok), "version": version,
+                    "staged": self.rollout.candidate_version == version}
+        if target in ("serving", "both"):
+            self.engine.swap_weights(tree, version)
+        if target in ("generation", "both"):
+            self.generator.swap_weights(tree, version)
+        return {"ok": True, "version": version, "staged": False}
+
+    def _version(self) -> dict:
+        """Live version digest: what every engine on this server is
+        serving right now (plus controller state when mounted) — the
+        fleet-skew view ``health.cli watch`` renders."""
+        out = {
+            "model_version": self.engine.model_version,
+            "last_swap_time": self.engine.last_swap_time,
+        }
+        if self.generator is not None:
+            out["decode_model_version"] = self.generator.model_version
+            out["decode_live_versions"] = sorted(self.generator._versions)
+        if self.rollout is not None:
+            out["rollout"] = self.rollout.status()
+        return out
 
     def _infer(self, conn, header: dict, blobs: list):
         if len(blobs) != 1:
@@ -211,7 +280,7 @@ class ServingServer:
                 f"rows of shape {shape[1:]} sent to an engine serving "
                 f"{self.engine.input_shape}")
         timeout_ms = header.get("timeout_ms")
-        with telemetry.use_trace(self._request_trace(header)):
+        with telemetry.use_trace(self._request_trace(header, self.engine)):
             with telemetry.span("trace.request", op="infer",
                                 rows=int(shape[0])):
                 futures = self.engine.submit_many(x, timeout_ms=timeout_ms)
@@ -246,7 +315,7 @@ class ServingServer:
         # the request's trace: queue-wait/prefill/decode spans come from
         # the engine (explicit context, scheduler thread); the stream
         # flushes below are the server's own children of the same trace
-        ctx = self._request_trace(header)
+        ctx = self._request_trace(header, self.generator)
         q: "queue.SimpleQueue[int]" = queue.SimpleQueue()
         fut = self.generator.generate(prompt, stream=q.put, trace=ctx, **kw)
         while True:
@@ -373,6 +442,30 @@ class ServingClient:
                 f"stream frames ({len(streamed)} tokens) disagree with the "
                 f"final frame ({tokens.size} tokens)")
         return GenerationResult(tokens, resp["reason"])
+
+    def put_weights(self, params, version: int,
+                    target: str = "serving") -> dict:
+        """Push a weight tree as ``version`` (the publish wire leg): the
+        server installs it into its engines (through the rollout
+        controller's canary rails when one is mounted). ``target``:
+        ``"serving"`` | ``"generation"`` | ``"both"``."""
+        from distkeras_tpu.parallel.remote_ps import _TreeCodec
+
+        codec = _TreeCodec(params)
+        header = {"op": "weights_put", "version": int(version),
+                  "target": target}
+        resp, _ = self._roundtrip(header, codec.encode(params, kind="pull"))
+        if "error" in resp:
+            raise RuntimeError(
+                f"serving ({resp.get('kind', '?')}): {resp['error']}")
+        return resp
+
+    def version(self) -> dict:
+        """The server's live version digest (see ``_version``)."""
+        resp, _ = self._roundtrip({"op": "version"})
+        if "error" in resp:
+            raise RuntimeError(f"serving: {resp['error']}")
+        return resp
 
     def stats(self) -> dict:
         resp, _ = self._roundtrip({"op": "stats"})

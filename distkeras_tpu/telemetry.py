@@ -196,6 +196,25 @@ METRIC_NAMES = {
     "serving.decode.tokens": "counter",
     "serving.decode.tokens_per_s": "gauge",
     "serving.decode.ttft_s": "histogram",
+    # live rollout / canary / rollback plane (serving/rollout.py,
+    # DESIGN.md §18)
+    "rollout.canary.agreement": "gauge",
+    "rollout.canary.evals": "counter",
+    "rollout.canary.mirrored": "counter",
+    "rollout.last_swap_time": "gauge",
+    "rollout.mirror_errors": "counter",
+    "rollout.model_version": "gauge",
+    "rollout.promotions": "counter",
+    "rollout.publish_dropped": "counter",
+    "rollout.publishes": "counter",
+    "rollout.rejections": "counter",
+    "rollout.rollbacks": "counter",
+    "rollout.stale_publishes": "counter",
+    "rollout.swap_s": "histogram",
+    "rollout.swaps": "counter",
+    "rollout.torn_swaps_blocked": "counter",
+    "rollout.version_groups": "histogram",
+    "rollout.versions_retired": "counter",
     # trainer lifecycle
     "trainer.training_time_s": "gauge",
     # flight recorder (health/recorder.py): bounded forensic ring + dumps

@@ -51,8 +51,13 @@ class Trainer:
                  loss_weights=None,
                  checkpoint_dir: Optional[str] = None,
                  telemetry_path: Optional[str] = None,
-                 precision: Optional[str] = None):
+                 precision: Optional[str] = None,
+                 weight_publisher=None):
         self.model = model
+        #: optional serving/rollout.py WeightPublisher: trained snapshots
+        #: are published (monotone-versioned) per sync epoch and at the
+        #: end of training, closing the train→serve loop (DESIGN.md §18)
+        self.weight_publisher = weight_publisher
         self.loss = loss
         base_loss = losses_lib.get(loss)  # fail fast on unknown loss names
         # Reference Trainer holds loss_weights (Keras multi-output scaling).
@@ -178,6 +183,11 @@ class Trainer:
     def _stop(self):
         self.training_time = time.perf_counter() - self._t0
         telemetry.gauge("trainer.training_time_s").set(self.training_time)
+        if self.weight_publisher is not None and self.params is not None:
+            # final snapshot publish: every trainer sets self.params
+            # before _stop(), so the serving plane always sees the run's
+            # end state even without per-epoch cadence
+            self.weight_publisher.publish(self.params)
         # refresh the HBM gauges (peak over the run lives in the allocator's
         # peak_bytes_in_use counter); no-op on backends without memory_stats
         from distkeras_tpu import observability
@@ -342,13 +352,15 @@ class DistributedTrainer(Trainer):
                  ps_shards: int = 1,
                  ps_placement: str = "process0",
                  ps_standby: bool = False,
+                 weight_publisher=None,
                  **strategy_kwargs):
         super().__init__(model, loss, worker_optimizer, learning_rate,
                          metrics, features_col, label_col, batch_size,
                          num_epoch, seed, loss_weights=loss_weights,
                          checkpoint_dir=checkpoint_dir,
                          telemetry_path=telemetry_path,
-                         precision=precision)
+                         precision=precision,
+                         weight_publisher=weight_publisher)
         from distkeras_tpu.parallel import mesh as mesh_lib
 
         if mode not in ("sync", "host_async"):
@@ -834,6 +846,11 @@ class DistributedTrainer(Trainer):
                                   [round_offset, self.num_updates,
                                    self.num_workers], np.int64)},
                     "carries": carries})
+            if self.weight_publisher is not None:
+                # per-epoch publish cadence (DESIGN.md §18): the serving
+                # plane canaries each epoch's center while training runs
+                self.weight_publisher.publish(device_get_batched(center),
+                                              clock=round_offset)
         if ckpt is not None:
             ckpt.wait()
             ckpt.close()

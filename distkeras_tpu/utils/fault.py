@@ -106,7 +106,11 @@ def apply(site: str, value: float) -> float:
 #: the call site (the site knows its socket); this module only meters.
 #: "kill" is the strongest: the serving SERVICE dies (listener + every
 #: connection — simulated process death), not just one connection.
-CHAOS_ACTIONS = ("drop", "delay", "reset", "reset_after_send", "kill")
+#: "torn" is the weight-publish fault: the payload arrives structurally
+#: valid but half-serialized (wrong leaf shapes) — the subscriber's swap
+#: validation must refuse it atomically.
+CHAOS_ACTIONS = ("drop", "delay", "reset", "reset_after_send", "kill",
+                 "torn")
 
 
 class ChaosAction:
@@ -159,6 +163,12 @@ def inject_chaos(site: str, action: str, after: int = 0,
       shard, ``reset`` closes the connection instead of replying,
       ``kill`` takes the whole service down (DESIGN.md §17's
       coordinator-death drill).
+    - ``"rollout.publish"`` — the weight-publish path
+      (``WeightPublisher.publish``, serving/rollout.py): ``drop`` loses
+      the publish (serving keeps the incumbent), ``delay`` stalls the
+      publisher ``delay_s``, ``torn`` delivers a half-serialized tree —
+      engine swap validation must refuse it and keep serving the
+      incumbent bit-for-bit (the swap-atomicity drill, DESIGN.md §18).
     """
     if action not in CHAOS_ACTIONS:
         raise ValueError(f"chaos action must be one of {CHAOS_ACTIONS}, "

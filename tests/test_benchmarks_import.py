@@ -20,7 +20,7 @@ PKG_MODULES = sorted(
 
 def test_discovery_found_the_tools():
     # the floor protects against the glob silently matching nothing
-    assert len(SCRIPTS) >= 15, SCRIPTS
+    assert len(SCRIPTS) >= 16, SCRIPTS
     assert "distkeras_tpu.benchmarks.run_config" in PKG_MODULES
     # the serving load generator (ISSUE 2) must be under the smoke glob
     assert any(os.path.basename(p) == "serving_load.py" for p in SCRIPTS)
@@ -44,6 +44,9 @@ def test_discovery_found_the_tools():
                for p in SCRIPTS)
     # the coordinator-failover probe (ISSUE 12) too
     assert any(os.path.basename(p) == "failover_probe.py"
+               for p in SCRIPTS)
+    # the live-rollout probe (ISSUE 13) too
+    assert any(os.path.basename(p) == "rollout_probe.py"
                for p in SCRIPTS)
 
 

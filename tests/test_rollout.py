@@ -1,0 +1,664 @@
+"""Live-rollout tests: versioned hot-swap, canary scoring, SLO rollback
+(ISSUE 13 acceptance, DESIGN.md §18).
+
+The load-bearing guarantees:
+
+- a weight swap is zero-recompile: the per-bucket / per-ladder compile
+  caches are BIT-FOR-BIT the same dict before and after swap + rollback;
+- every served batch is computed entirely on version N or N+1 — bitwise
+  equal to one version's reference outputs, never a blend;
+- an in-flight generation request finishes on the version it started on
+  (per-slot pinning), and retired versions are reclaimed only after the
+  last pinned slot drains;
+- rollback restores the last-good version bit-identically, and a second
+  rollback is a no-op (idempotent — never a walk further into history);
+- a torn (half-serialized) publish is refused atomically: the incumbent
+  keeps serving bit-for-bit and nothing half-installed ever executes;
+- an SLO breach on canary agreement auto-rolls-back via ``on_breach``
+  with zero failed in-flight requests and a postmortem bundle carrying
+  the breach context plus both version fingerprints.
+"""
+
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.evaluators import CanaryAgreementEvaluator
+from distkeras_tpu.health import recorder as flight_recorder
+from distkeras_tpu.health.recorder import FlightRecorder, find_bundles
+from distkeras_tpu.health.slo import SloEngine, SloSpec, rollout_on_breach
+from distkeras_tpu.models.mlp import MLP
+from distkeras_tpu.serving import (
+    CanaryConfig,
+    GenerationEngine,
+    RolloutController,
+    ServingEngine,
+    WeightPublisher,
+)
+from distkeras_tpu.serving.rollout import _torn_copy, validate_tree_like
+from distkeras_tpu.utils import fault
+
+FEATS = 12
+CLASSES = 4
+
+
+@pytest.fixture(autouse=True)
+def fresh_planes():
+    """Fresh telemetry registry, flight recorder, and chaos table per
+    test: engines capture metric objects at construction, the recorder
+    accumulates fingerprints/dump-reasons, and chaos budgets persist."""
+    telemetry.reset()
+    flight_recorder.install(FlightRecorder())
+    fault.clear_chaos()
+    yield
+    fault.clear_chaos()
+    flight_recorder.install(FlightRecorder())
+    telemetry.reset()
+
+
+@pytest.fixture(scope="module")
+def mlp():
+    model = MLP(features=(16,), num_classes=CLASSES)
+    params = model.init(jax.random.key(0), jnp.zeros((2, FEATS)),
+                        train=False)["params"]
+    return model, params
+
+
+def _engine(mlp, **kw):
+    model, params = mlp
+    kw.setdefault("buckets", (8,))
+    kw.setdefault("max_wait_ms", 20.0)
+    return ServingEngine(model, params, input_shape=(FEATS,), **kw)
+
+
+def _rows(n, seed=0):
+    return np.random.default_rng(seed).normal(size=(n, FEATS)) \
+        .astype(np.float32)
+
+
+def _perturbed(params, eps=0.5):
+    return jax.tree.map(lambda a: a + eps, params)
+
+
+def _copy(params):
+    """A new-arrays copy of ``params`` — a distinct *deployment* with
+    identical numerics (bitwise-equal outputs)."""
+    return jax.tree.map(np.array, params)
+
+
+def _forced_class(params, cls):
+    """Params whose final head always predicts ``cls``: zero kernel,
+    one-hot bias. Deterministically disagrees with the incumbent on
+    every row the incumbent does NOT classify as ``cls``."""
+    import flax
+
+    flat = flax.traverse_util.flatten_dict(
+        jax.tree.map(np.array, params))
+    for k, v in flat.items():
+        if v.shape[-1] == CLASSES:
+            if v.ndim >= 2:
+                flat[k] = np.zeros_like(v)
+            else:
+                b = np.zeros_like(v)
+                b[cls] = 100.0
+                flat[k] = b
+    return flax.traverse_util.unflatten_dict(flat)
+
+
+def _batch_out(eng, rows):
+    return np.stack([f.result(30) for f in eng.submit_many(rows)])
+
+
+# ---------------------------------------------------------------- validation
+
+def test_validate_tree_like_refuses_incompatible_trees(mlp):
+    _, params = mlp
+    validate_tree_like(_perturbed(params), params)  # compatible: no raise
+    with pytest.raises(ValueError, match="shape"):
+        validate_tree_like(_torn_copy(params), params)
+    with pytest.raises(ValueError, match="structure"):
+        validate_tree_like({"not": np.zeros(3)}, params)
+    cast = jax.tree.map(lambda a: np.asarray(a, np.float64), params)
+    with pytest.raises(ValueError, match="dtype"):
+        validate_tree_like(cast, params)
+
+
+# ------------------------------------------------------- dense engine swaps
+
+def test_swap_changes_outputs_with_zero_recompile(mlp):
+    _, params = mlp
+    eng = _engine(mlp)
+    try:
+        cache0 = eng.compiled_buckets
+        rows = _rows(8)
+        out_a = _batch_out(eng, rows)
+        eng.swap_weights(_perturbed(params), 1)
+        out_b = _batch_out(eng, rows)
+        assert not np.array_equal(out_a, out_b)
+        assert eng.model_version == 1
+        assert eng.last_swap_time is not None
+        assert eng.compiled_buckets == cache0  # zero recompile
+        st = eng.health_status()
+        assert st["model_version"] == 1
+        assert st["last_swap_time"] is not None
+    finally:
+        eng.shutdown()
+
+
+def test_batches_entirely_on_one_version_under_swap_churn(mlp):
+    """Bitwise parity: under concurrent swap churn every 8-row batch is
+    computed ENTIRELY on version N or N+1 — equal to one version's
+    quiesced reference outputs, never a mix of rows from both."""
+    _, p_a = mlp
+    p_b = _perturbed(p_a)
+    eng = _engine(mlp, max_batch_size=8)
+    try:
+        rows = _rows(8)
+        ref_a = _batch_out(eng, rows)
+        eng.swap_weights(p_b, 1)
+        ref_b = _batch_out(eng, rows)
+        eng.swap_weights(p_a, 2)
+        assert not np.array_equal(ref_a, ref_b)
+        cache0 = eng.compiled_buckets
+
+        stop = threading.Event()
+        versions = iter(range(3, 1000))
+
+        def churn():
+            flip = True
+            while not stop.is_set():
+                eng.swap_weights(p_b if flip else p_a, next(versions))
+                flip = not flip
+                time.sleep(0.002)
+
+        t = threading.Thread(target=churn, daemon=True)
+        t.start()
+        try:
+            for _ in range(30):
+                out = _batch_out(eng, rows)
+                assert np.array_equal(out, ref_a) \
+                    or np.array_equal(out, ref_b), \
+                    "batch blended rows from two versions"
+        finally:
+            stop.set()
+            t.join(10)
+        assert eng.compiled_buckets == cache0
+    finally:
+        eng.shutdown()
+
+
+def test_shadow_forward_matches_live_outputs_bitwise(mlp):
+    _, p_a = mlp
+    p_b = _perturbed(p_a)
+    eng = _engine(mlp)
+    try:
+        rows = _rows(8, seed=3)
+        shadow = eng.shadow_forward(p_b, rows)
+        eng.swap_weights(p_b, 1)
+        live = _batch_out(eng, rows)
+        np.testing.assert_array_equal(shadow, live)
+    finally:
+        eng.shutdown()
+
+
+# ----------------------------------------------------- generation pinning
+
+@pytest.fixture(scope="module")
+def lm():
+    from distkeras_tpu.models.gpt import gpt_tiny
+
+    model = gpt_tiny()
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def test_inflight_generation_completes_on_pinned_version(lm):
+    model, p_a = lm
+    p_b = jax.tree.map(lambda a: a + 0.1, p_a)
+    gen = GenerationEngine(model, p_a, num_slots=4, prefill_buckets=(8,))
+    try:
+        prompt = np.arange(1, 6, dtype=np.int32)
+        ref_a = gen.generate(prompt, max_new_tokens=12).result(30).tokens
+        cache0 = gen.compiled_executables
+
+        started = threading.Event()
+        fut = gen.generate(prompt, max_new_tokens=12,
+                           stream=lambda t: started.set())
+        assert started.wait(10)
+        gen.swap_weights(p_b, 1)  # returns once the scheduler installed it
+        res = fut.result(30)
+        # the in-flight request finished on its PINNED version A
+        np.testing.assert_array_equal(res.tokens, ref_a)
+        assert gen.model_version == 1
+
+        # a post-swap request runs on B and produces different tokens
+        tok_b = gen.generate(prompt, max_new_tokens=12).result(30).tokens
+        assert not np.array_equal(tok_b, ref_a)
+        assert gen.compiled_executables == cache0  # zero recompile
+
+        # version A retired once its last pinned slot drained
+        deadline = time.time() + 10
+        while sorted(gen._versions) != [1] and time.time() < deadline:
+            time.sleep(0.05)
+        assert sorted(gen._versions) == [1]
+        snap = telemetry.get_registry().snapshot()
+        assert any(k.startswith("rollout.versions_retired")
+                   for k in snap["counters"])
+
+        # swap back to A: bit-identical restore
+        gen.swap_weights(p_a, 2)
+        tok_a2 = gen.generate(prompt, max_new_tokens=12).result(30).tokens
+        np.testing.assert_array_equal(tok_a2, ref_a)
+        assert gen.compiled_executables == cache0
+
+        st = gen.health_status()
+        assert st["model_version"] == 2
+        assert st["last_swap_time"] is not None
+        assert st["live_versions"] == [2] or 2 in st["live_versions"]
+    finally:
+        gen.shutdown()
+
+
+def test_generation_swap_refuses_torn_tree(lm):
+    model, p_a = lm
+    gen = GenerationEngine(model, p_a, num_slots=2, prefill_buckets=(8,))
+    try:
+        with pytest.raises(ValueError, match="rejected"):
+            gen.swap_weights(_torn_copy(p_a), 1)
+        assert gen.model_version == 0
+        prompt = np.arange(1, 6, dtype=np.int32)
+        assert gen.generate(prompt, max_new_tokens=4).result(30) is not None
+    finally:
+        gen.shutdown()
+
+
+# ------------------------------------------------------ controller/rollback
+
+def test_rollback_restores_bit_identical_and_is_idempotent(mlp):
+    _, p_a = mlp
+    eng = _engine(mlp)
+    try:
+        ctl = RolloutController(engine=eng)  # no canary: stage == promote
+        rows = _rows(8, seed=7)
+        ref_a = _batch_out(eng, rows)
+        cache0 = eng.compiled_buckets
+
+        assert ctl.stage(1, _perturbed(p_a))
+        assert ctl.current_version == 1 and eng.model_version == 1
+        assert not np.array_equal(_batch_out(eng, rows), ref_a)
+
+        assert ctl.rollback()  # first rollback swaps
+        assert ctl.current_version == 0 and eng.model_version == 0
+        np.testing.assert_array_equal(_batch_out(eng, rows), ref_a)
+
+        assert not ctl.rollback()  # double rollback: idempotent no-op
+        assert ctl.current_version == 0
+        np.testing.assert_array_equal(_batch_out(eng, rows), ref_a)
+        assert eng.compiled_buckets == cache0
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"].get("rollout.rollbacks") == 1
+    finally:
+        eng.shutdown()
+
+
+def test_stale_publish_refused(mlp):
+    _, p_a = mlp
+    eng = _engine(mlp)
+    try:
+        ctl = RolloutController(engine=eng)
+        assert ctl.stage(1, _perturbed(p_a))
+        assert not ctl.stage(1, p_a)  # same version: stale
+        assert not ctl.stage(0, p_a)  # older: stale
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"].get("rollout.stale_publishes") == 2
+    finally:
+        eng.shutdown()
+
+
+# ------------------------------------------------------------- chaos drills
+
+def test_torn_publish_never_serves_half_installed_tree(mlp):
+    """The swap-atomicity drill: a chaos-torn publish is refused at the
+    staging gate, the incumbent keeps serving BIT-FOR-BIT, and the
+    compile cache never grows."""
+    _, p_a = mlp
+    eng = _engine(mlp)
+    try:
+        ctl = RolloutController(
+            engine=eng, canary=CanaryConfig(fraction=1.0, min_rows=4))
+        pub = WeightPublisher()
+        pub.subscribe(lambda v, p, c: ctl.stage(v, p))
+        rows = _rows(8, seed=11)
+        ref = _batch_out(eng, rows)
+        cache0 = eng.compiled_buckets
+
+        fault.inject_chaos("rollout.publish", "torn")
+        assert pub.publish(_perturbed(p_a)) == 1  # delivered, but torn
+        assert ctl.current_version == 0  # refused: never installed
+        assert ctl.candidate_version is None  # refused even as candidate
+        np.testing.assert_array_equal(_batch_out(eng, rows), ref)
+        assert eng.compiled_buckets == cache0
+        snap = telemetry.get_registry().snapshot()
+        torn = [k for k in snap["counters"]
+                if k.startswith("rollout.torn_swaps_blocked")]
+        assert torn, "torn swap must be counted"
+
+        fault.clear_chaos()  # budget consumed; next publish is clean
+        assert pub.publish(_copy(p_a)) == 2
+        assert ctl.candidate_version == 2  # staged, awaiting canary
+    finally:
+        eng.shutdown()
+
+
+def test_dropped_and_delayed_publish_chaos(mlp):
+    _, p_a = mlp
+    eng = _engine(mlp)
+    try:
+        ctl = RolloutController(engine=eng)
+        pub = WeightPublisher()
+        pub.subscribe(lambda v, p, c: ctl.stage(v, p))
+
+        fault.inject_chaos("rollout.publish", "drop")
+        assert pub.publish(p_a) is None  # dropped: no version minted
+        assert pub.version == 0 and ctl.current_version == 0
+
+        fault.inject_chaos("rollout.publish", "delay", delay_s=0.05)
+        t0 = time.perf_counter()
+        assert pub.publish(_perturbed(p_a)) == 1
+        assert time.perf_counter() - t0 >= 0.05
+        assert ctl.current_version == 1
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"].get("rollout.publish_dropped") == 1
+    finally:
+        eng.shutdown()
+
+
+# ------------------------------------------------------------------- canary
+
+def test_canary_mirrors_scores_and_promotes(mlp):
+    _, p_a = mlp
+    eng = _engine(mlp)
+    try:
+        ctl = RolloutController(
+            engine=eng,
+            canary=CanaryConfig(fraction=1.0, min_rows=8, threshold=0.98))
+        rows = _rows(8, seed=13)
+        ref = _batch_out(eng, rows)  # serves AND mirrors (fraction=1.0)
+        deadline = time.time() + 10
+        while ctl.mirrored_rows() is None and time.time() < deadline:
+            time.sleep(0.02)  # the tap runs on the batcher thread
+        assert len(ctl.mirrored_rows()) >= 8
+
+        assert ctl.evaluate_canary() is None  # nothing staged yet
+        assert ctl.stage(1, _copy(p_a))
+        assert ctl.current_version == 0  # staged, NOT yet promoted
+        score = ctl.evaluate_canary()
+        assert score == 1.0  # identical numerics: full agreement
+        assert ctl.current_version == 1  # promoted
+        assert ctl.candidate_version is None
+        np.testing.assert_array_equal(_batch_out(eng, rows), ref)
+        assert ctl.status()["last_agreement"] == 1.0
+    finally:
+        eng.shutdown()
+
+
+def test_canary_rejects_low_agreement_candidate(mlp):
+    _, p_a = mlp
+    eng = _engine(mlp)
+    try:
+        ctl = RolloutController(
+            engine=eng,
+            canary=CanaryConfig(fraction=1.0, min_rows=8, threshold=0.9))
+        rows = _rows(64, seed=17)
+        # forced-least-common-class candidate: agreement <= 1/CLASSES
+        inc_pred = np.argmax(eng.shadow_forward(p_a, rows), axis=-1)
+        cls = int(np.argmin(np.bincount(inc_pred, minlength=CLASSES)))
+        bad = _forced_class(p_a, cls)
+
+        ref = _batch_out(eng, rows[:8])
+        assert ctl.stage(1, bad)
+        score = ctl.evaluate_canary(rows=rows)
+        assert score is not None and score < 0.9
+        assert ctl.current_version == 0  # rejected: incumbent stays
+        assert ctl.candidate_version is None  # candidate discarded
+        np.testing.assert_array_equal(_batch_out(eng, rows[:8]), ref)
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"].get("rollout.rejections") == 1
+        assert snap["gauges"].get("rollout.canary.agreement") == score
+    finally:
+        eng.shutdown()
+
+
+def test_canary_agreement_evaluator_is_rowwise_argmax_agreement():
+    ev = CanaryAgreementEvaluator()
+    cand = np.array([[0.9, 0.1], [0.2, 0.8], [0.7, 0.3], [0.4, 0.6]])
+    inc = np.array([[0.8, 0.2], [0.3, 0.7], [0.1, 0.9], [0.45, 0.55]])
+    assert ev.evaluate({"candidate": cand, "incumbent": inc}) == 0.75
+
+
+# ------------------------------------------- publisher -> PS -> controller
+
+def test_publisher_stamps_ps_and_controller_polls(mlp):
+    from distkeras_tpu.parameter_servers import DeltaParameterServer
+
+    _, p_a = mlp
+    ps = DeltaParameterServer(jax.device_put(p_a))
+    assert ps.model_version == 0
+    ps.set_model_version(2)
+    with pytest.raises(ValueError, match="monotone"):
+        ps.set_model_version(2)
+    center, clock, version = ps.pull_versioned()
+    assert version == 2 and clock == 0
+
+    eng = _engine(mlp)
+    try:
+        ctl = RolloutController(engine=eng, source=ps)
+        pub = WeightPublisher(ps=ps, start_version=ps.model_version)
+        assert pub.publish() == 3  # pulls the live center from the ps
+        assert ps.model_version == 3
+        assert ctl.poll()  # sees version 3, stages+promotes
+        assert ctl.current_version == 3 and eng.model_version == 3
+        assert not ctl.poll()  # nothing newer
+    finally:
+        eng.shutdown()
+
+
+def test_remote_ps_version_ops_over_the_wire(mlp):
+    from distkeras_tpu.parameter_servers import DeltaParameterServer
+    from distkeras_tpu.parallel.remote_ps import (
+        ParameterServerService,
+        RemoteParameterServer,
+    )
+
+    _, p_a = mlp
+    ps = DeltaParameterServer(jax.device_put(p_a))
+    svc = ParameterServerService(ps, p_a, expected_processes=1)
+    svc.start()
+    try:
+        cli = RemoteParameterServer(f"127.0.0.1:{svc.port}", p_a)
+        assert cli.model_version == 0
+        cli.set_model_version(5)
+        assert cli.model_version == 5
+        _center, clock, version = cli.pull_versioned()
+        assert version == 5 and clock == 0
+        with pytest.raises(RuntimeError, match="monotone"):
+            cli.set_model_version(4)
+        cli.close()
+    finally:
+        svc.stop()
+
+
+# ------------------------------------------------------------ serving wire
+
+def test_server_weights_put_and_version_ops(mlp):
+    from distkeras_tpu.serving import ServingClient, ServingServer
+
+    model, p_a = mlp
+    eng = _engine(mlp)
+    srv = ServingServer(eng, host="127.0.0.1")
+    srv.start()
+    try:
+        cli = ServingClient(f"127.0.0.1:{srv.port}")
+        v = cli.version()
+        assert v["model_version"] == 0
+        resp = cli.put_weights(_perturbed(p_a), 1)
+        assert resp["ok"] and resp["version"] == 1
+        assert eng.model_version == 1
+        assert cli.version()["model_version"] == 1
+        with pytest.raises(RuntimeError, match="target"):
+            cli.put_weights(p_a, 2, target="bogus")
+        cli.close()
+    finally:
+        srv.stop()
+        eng.shutdown()
+
+
+def test_server_weights_put_routes_through_rollout_controller(mlp):
+    from distkeras_tpu.serving import ServingClient, ServingServer
+
+    _, p_a = mlp
+    eng = _engine(mlp)
+    ctl = RolloutController(
+        engine=eng, canary=CanaryConfig(fraction=1.0, min_rows=4))
+    srv = ServingServer(eng, host="127.0.0.1", rollout=ctl)
+    srv.start()
+    try:
+        cli = ServingClient(f"127.0.0.1:{srv.port}")
+        resp = cli.put_weights(_copy(p_a), 1)
+        assert resp["ok"] and resp["staged"]
+        assert ctl.candidate_version == 1  # staged for canary, not live
+        assert eng.model_version == 0
+        v = cli.version()
+        assert v["rollout"]["candidate_version"] == 1
+        assert v["rollout"]["current_version"] == 0
+        cli.close()
+    finally:
+        srv.stop()
+        eng.shutdown()
+
+
+# --------------------------------------------------------------- CLI skew
+
+def test_watch_table_reports_fleet_version_skew():
+    from distkeras_tpu.health.cli import _fleet_versions, _watch_table
+
+    telemetry.gauge("rollout.model_version", engine="serving").set(3)
+    telemetry.gauge("rollout.model_version", engine="generation").set(2)
+    rows = list(telemetry.get_registry().rows())
+    fleet = _fleet_versions(rows)
+    assert fleet == {"serving": 3, "generation": 2}
+    table = _watch_table({}, {}, 1.0, fleet_versions=fleet)
+    assert "VERSIONS:" in table and "SKEW" in table
+    telemetry.gauge("rollout.model_version", engine="generation").set(3)
+    fleet = _fleet_versions(list(telemetry.get_registry().rows()))
+    assert "SKEW" not in _watch_table({}, {}, 1.0, fleet_versions=fleet)
+
+
+# ---------------------------------------------------------- trainer publish
+
+def test_trainer_publishes_final_snapshot():
+    from distkeras_tpu.data.dataset import synthetic_mnist
+    from distkeras_tpu.trainers import SingleTrainer
+
+    model = MLP(features=(16,), num_classes=10)
+    seen = []
+    pub = WeightPublisher()
+    pub.subscribe(lambda v, p, c: seen.append((v, p)))
+    tr = SingleTrainer(model, batch_size=32, num_epoch=1,
+                       weight_publisher=pub)
+    tr.train(synthetic_mnist(64))
+    assert seen and seen[-1][0] == pub.version >= 1
+    # the published tree is the trained params, swap-compatible
+    validate_tree_like(seen[-1][1], tr.params)
+
+
+# --------------------------------------------------- end-to-end acceptance
+
+def test_slo_breach_auto_rolls_back_with_forensics(mlp, tmp_path):
+    """ISSUE 13 acceptance: a canary version breaching the agreement SLO
+    under mirrored traffic auto-rolls-back to last-good with zero failed
+    in-flight requests, zero recompiles, and a postmortem bundle carrying
+    the breach context and both version fingerprints."""
+    _, p_a = mlp
+    flight_recorder.configure(dump_dir=str(tmp_path))
+    eng = _engine(mlp, max_batch_size=8)
+    try:
+        # local canary gate deliberately permissive (0.2) — the org-level
+        # SLO floor (0.9) is the stricter guard that catches the bad rev
+        ctl = RolloutController(
+            engine=eng,
+            canary=CanaryConfig(fraction=1.0, min_rows=8, threshold=0.2))
+        slo = SloEngine(
+            [SloSpec("canary-agreement", "rollout.canary.agreement",
+                     0.9, op=">=")],
+            on_breach=rollout_on_breach(ctl))
+
+        rows = _rows(64, seed=23)
+        ref = _batch_out(eng, rows[:8])  # also feeds the mirror
+        cache0 = eng.compiled_buckets
+
+        # v1: a good deployment (identical numerics) canaries and promotes
+        assert ctl.stage(1, _copy(p_a))
+        assert ctl.evaluate_canary(rows=rows) == 1.0
+        assert ctl.current_version == 1
+        assert not slo.evaluate_once()  # agreement 1.0: no breach
+
+        # v2: a bad deployment sneaks past the permissive local gate —
+        # forcing the incumbent's MOST common class keeps agreement >=
+        # 1/CLASSES (pigeonhole) but far under the 0.9 SLO floor
+        inc_pred = np.argmax(eng.shadow_forward(p_a, rows), axis=-1)
+        cls = int(np.argmax(np.bincount(inc_pred, minlength=CLASSES)))
+        assert ctl.stage(2, _forced_class(p_a, cls))
+        score = ctl.evaluate_canary(rows=rows)
+        assert 0.2 <= score < 0.9  # breach-level, yet past the local gate
+        assert ctl.current_version == 2  # promoted: the bad rev is live
+
+        # in-flight traffic submitted BEFORE the breach evaluation
+        inflight = eng.submit_many(rows[:8])
+
+        alerts = slo.evaluate_once()
+        assert alerts and alerts[0].slo == "canary-agreement"
+
+        # auto-rollback restored last-good v1, bit-identically
+        assert ctl.current_version == 1 and eng.model_version == 1
+        np.testing.assert_array_equal(_batch_out(eng, rows[:8]), ref)
+
+        # zero failed in-flight requests across the swap
+        got = [f.result(30) for f in inflight]
+        assert len(got) == 8 and all(g is not None for g in got)
+
+        # zero recompiles across promote + rollback
+        assert eng.compiled_buckets == cache0
+
+        # a second breach evaluation is a no-op rollback (idempotent)
+        slo.evaluate_once()
+        assert ctl.current_version == 1
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"].get("rollout.rollbacks") == 1
+
+        # postmortem bundle: breach context + both version fingerprints
+        bundles = find_bundles(str(tmp_path))
+        assert bundles, "breach must dump a postmortem bundle"
+        with open(bundles[0]) as f:
+            bundle = json.load(f)
+        assert bundle["fingerprint"]["serving_model_version"] == 1
+        assert bundle["fingerprint"]["rollback_from_version"] == 2
+        rollbacks = [e for e in bundle["events"]
+                     if e.get("kind") == "rollout"
+                     and e.get("fields", {}).get("action") == "rollback"]
+        assert rollbacks
+        assert rollbacks[0]["fields"]["slo"] == "canary-agreement"
+        assert rollbacks[0]["fields"]["from_version"] == 2
+        assert rollbacks[0]["fields"]["to_version"] == 1
+        alerts_ev = [e for e in bundle["events"]
+                     if e.get("kind") == "alert"]
+        assert alerts_ev, "bundle must carry the breach context"
+    finally:
+        eng.shutdown()
