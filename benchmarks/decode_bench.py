@@ -13,6 +13,23 @@ mixed prompt lengths and mixed ``max_new_tokens``, ``--slots`` lanes):
 - **continuous**: the real :class:`GenerationEngine` — iteration-level
   admission/retirement over the slot pool (DESIGN.md §14).
 
+Three more legs exercise the decode accelerations (DESIGN.md §19), each
+building its own workload shape from a fixed internal seed:
+
+- **prefix**: shared-prefix traffic against a prefix-cached paged
+  engine — a cold round (every prompt is a miss) then a warm round of
+  the SAME prompts (every prompt a full hit served with zero forwards).
+  Reports cold/warm TTFT and their ratio (acceptance: warm >= 2x lower).
+- **longtail**: a paged engine whose page budget is a fraction of the
+  rectangular reservation for the same slot count, serving a
+  short-heavy mix with a few near-max_len stragglers — the workload a
+  rect pool cannot admit within the same HBM. Reports HBM bytes per
+  live request for both layouts and the peak page occupancy.
+- **speculative**: the same mixed workload through a plain engine and a
+  ``spec_k=3`` + :class:`NgramDraft` engine; reports useful-tokens/s
+  for both, the speedup, the accept rate, and whether the outputs are
+  token-identical (they must be — speculation is exact).
+
 Prints one JSON line per mode plus a summary row with the speedup
 ratios (ISSUE 9 acceptance: continuous >= 3x naive tokens/s at
 batch >= 4 on the CPU host). Tokens/s counts USEFUL tokens only
@@ -23,7 +40,8 @@ serving, not cold start.
 
 Usage:
   python benchmarks/decode_bench.py [--requests 8] [--slots 4]
-      [--modes naive,static,continuous] [--seed 0]
+      [--modes naive,static,continuous,prefix,longtail,speculative]
+      [--seed 0]
 
 CPU-safe (gpt_tiny); on a TPU host the same script exercises the device
 path unchanged. JSONL convention matches serving_load.py / step_probe.py.
@@ -213,6 +231,162 @@ def run_continuous(model, params, prompts, max_news, lanes: int) -> dict:
             "ttft_s_mean": float(np.mean(list(t_first.values())))}
 
 
+#: internal seed for the leg-specific workload shapes (prefix context,
+#: long-tail mix) — independent of --seed so the base workload row stays
+#: comparable across legs
+LEG_SEED = 1234
+
+
+def run_prefix(model, params, prompts, max_news, lanes: int) -> dict:
+    """Shared-prefix leg: cold round (all misses) then warm round of the
+    same prompts (all full hits). Prompts are a 64-token shared context
+    plus a short unique suffix — the system-prompt shape prefix caching
+    exists for. TTFT is measured per request, submitted one at a time so
+    queueing never pollutes the cold/warm comparison."""
+    from distkeras_tpu.serving.generation import GenerationEngine
+
+    rng = np.random.default_rng(LEG_SEED)
+    common = rng.integers(1, 256, size=64).tolist()
+    reqs = [common + list(p)[:16] for p in prompts]
+    eng = GenerationEngine(model, params, num_slots=lanes,
+                           prefill_buckets=(8, 32, 96),
+                           queue_capacity=max(64, 2 * len(reqs)),
+                           page_size=16, prefix_cache_bytes=8 << 20)
+    try:
+        def one_round():
+            ttfts, toks = [], 0
+            for p in reqs:
+                holder = {}
+                t0 = time.perf_counter()
+                fut = eng.generate(
+                    p, max_new_tokens=4,
+                    stream=lambda tok, h=holder, t=t0: h.setdefault(
+                        "ttft", time.perf_counter() - t))
+                toks += fut.result(timeout=600).tokens.size
+                ttfts.append(holder["ttft"])
+            return ttfts, toks
+
+        t0 = time.perf_counter()
+        cold, n_cold = one_round()
+        warm, n_warm = one_round()
+        wall = time.perf_counter() - t0
+        pc = eng.health_status()["prefix_cache"]
+    finally:
+        eng.shutdown()
+    ttft_cold = float(np.mean(cold))
+    ttft_warm = float(np.mean(warm))
+    return {"total_tokens": n_cold + n_warm, "wall_s": wall,
+            "tokens_per_s": (n_cold + n_warm) / wall,
+            "ttft_cold_s_mean": ttft_cold, "ttft_warm_s_mean": ttft_warm,
+            "ttft_speedup": ttft_cold / ttft_warm,
+            "prefix_hits": pc["hits"], "prefix_misses": pc["misses"],
+            "prefix_hit_rate": pc["hit_rate"],
+            "prefix_bytes": pc["bytes"]}
+
+
+def run_longtail(model, params, prompts, max_news, lanes: int) -> dict:
+    """Paged long-tail leg: a page budget of ~1/3 the rectangular
+    reservation serves a short-heavy mix with two near-max_len
+    stragglers. The rect pool for the same slot count simply cannot fit
+    this budget — the leg reports HBM bytes per live request for both
+    layouts plus the observed peak page occupancy."""
+    from distkeras_tpu.models.gpt import page_bytes
+    from distkeras_tpu.serving.generation import GenerationEngine
+
+    rng = np.random.default_rng(LEG_SEED)
+    page_size = 16
+    pages_per_slot = model.max_len // page_size
+    num_slots = max(8, 2 * lanes)
+    num_pages = (num_slots * pages_per_slot) // 3
+    shorts = [(rng.integers(1, 256, size=int(n)).tolist(), 8)
+              for n in rng.integers(4, 10, size=3 * len(prompts))]
+    longs = [(rng.integers(1, 256, size=20).tolist(), 100)
+             for _ in range(2)]
+    work = shorts + longs
+    work = [work[i] for i in rng.permutation(len(work))]
+
+    eng = GenerationEngine(model, params, num_slots=num_slots,
+                           prefill_buckets=PREFILL_BUCKETS,
+                           queue_capacity=max(64, len(work)),
+                           page_size=page_size, num_pages=num_pages)
+    try:
+        t_first = {}
+        peak_pages = 0
+        t0 = time.perf_counter()
+        futs = []
+        for i, (p, mnt) in enumerate(work):
+            stream = (lambda tok, i=i: t_first.setdefault(
+                i, time.perf_counter() - t0))
+            futs.append(eng.generate(p, max_new_tokens=mnt, stream=stream))
+        while not all(f.done() for f in futs):
+            peak_pages = max(peak_pages, eng.pool.pages_in_use)
+            time.sleep(0.0005)
+        total = sum(f.result(timeout=600).tokens.size for f in futs)
+        wall = time.perf_counter() - t0
+        paged_bytes = eng.pool.cache_bytes
+    finally:
+        eng.shutdown()
+    pb = page_bytes(model, page_size)
+    rect_bytes = (num_slots + 1) * pages_per_slot * pb
+    return {"total_tokens": total, "wall_s": wall,
+            "tokens_per_s": total / wall,
+            "ttft_s_mean": float(np.mean(list(t_first.values()))),
+            "requests_served": len(work), "num_slots": num_slots,
+            "num_pages": num_pages, "page_size": page_size,
+            "peak_pages_in_use": int(peak_pages),
+            "paged_hbm_bytes": int(paged_bytes),
+            "rect_hbm_bytes": int(rect_bytes),
+            "hbm_ratio_rect_over_paged": rect_bytes / paged_bytes,
+            "paged_bytes_per_slot": paged_bytes / (num_slots + 1),
+            "rect_bytes_per_slot": pages_per_slot * pb}
+
+
+def run_speculative(model, params, prompts, max_news, lanes: int,
+                    rounds: int = 3) -> dict:
+    """Speculative leg: the same workload through a plain continuous
+    engine and a spec_k=3 + NgramDraft engine, ``rounds`` measured
+    passes each with the MEDIAN useful-tokens/s reported (host wall
+    clocks are noisy; the median is the claim, single passes are not).
+    Plus the exactness receipt: the two engines' outputs must be
+    token-identical (greedy speculation changes WHEN tokens appear,
+    never WHICH)."""
+    from distkeras_tpu.serving.generation import GenerationEngine, NgramDraft
+
+    max_new = 96
+
+    def drive(**kw):
+        eng = GenerationEngine(model, params, num_slots=lanes,
+                               prefill_buckets=PREFILL_BUCKETS,
+                               queue_capacity=max(64, len(prompts)), **kw)
+        try:
+            tps, outs, total, wall = [], None, 0, 0.0
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                futs = [eng.generate(p, max_new_tokens=max_new)
+                        for p in prompts]
+                outs = [f.result(timeout=600).tokens.tolist()
+                        for f in futs]
+                wall = time.perf_counter() - t0
+                total = sum(len(t) for t in outs)
+                tps.append(total / wall)
+            status = eng.health_status()
+        finally:
+            eng.shutdown()
+        return sorted(tps)[len(tps) // 2], outs, total, wall, status
+
+    plain_tps, plain_out, _, _, _ = drive()
+    spec_tps, spec_out, spec_tok, spec_wall, status = drive(
+        draft=NgramDraft(ngram=2), spec_k=3)
+    sp = status["speculative"]
+    return {"total_tokens": spec_tok, "wall_s": spec_wall,
+            "rounds": rounds, "tokens_per_s": spec_tps,
+            "plain_tokens_per_s": plain_tps,
+            "speedup_vs_plain": spec_tps / plain_tps,
+            "spec_k": sp["spec_k"], "proposed": sp["proposed"],
+            "accepted": sp["accepted"], "accept_rate": sp["accept_rate"],
+            "outputs_identical": plain_out == spec_out}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=8)
@@ -226,7 +400,8 @@ def main(argv=None) -> int:
     model, params = _build_model(args.seed)
     prompts, max_news = _workload(args.requests, args.seed)
     runners = {"naive": run_naive, "static": run_static,
-               "continuous": run_continuous}
+               "continuous": run_continuous, "prefix": run_prefix,
+               "longtail": run_longtail, "speculative": run_speculative}
     base = {"bench": "decode", "requests": args.requests,
             "slots": args.slots, "platform": jax.default_backend(),
             "model": "gpt_tiny", "seed": args.seed}

@@ -1,0 +1,121 @@
+"""Paged-KV memory probe — rect vs paged HBM budgets, no engine needed.
+
+The decode benches measure wall clocks; this probe answers the sizing
+question planners actually ask: *for a given page size and a realistic
+request-length distribution, how many live conversations fit in the HBM
+a rectangular pool would burn on far fewer slots?* Pure arithmetic over
+the model's cache-geometry helpers (``models.gpt.page_bytes``), so it
+runs in milliseconds anywhere and the numbers are exact, not sampled.
+
+Per swept page size it reports, for a synthetic long-tail mix (70%
+short, 25% medium, 5% at max_len — the shape production traffic keeps
+having, DESIGN.md §19):
+
+- ``rect_bytes_per_slot`` — what one slot reserves regardless of use;
+- ``paged_bytes_per_request_mean`` — what the mix actually pins;
+- ``slots_equiv`` — live requests a paged pool fits inside the rect
+  pool's HBM budget for ``--slots`` slots (the headline ratio);
+- ``frag_bytes_per_request`` — mean last-page internal fragmentation
+  (the cost of larger pages; the reason page_size is a dial, not "as
+  big as possible").
+
+Usage:
+  python benchmarks/paged_memory_probe.py [--slots 64]
+      [--page-sizes 8,16,32,64] [--requests 512] [--seed 0]
+
+JSONL rows on stdout, convention matching decode_bench.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+try:
+    import distkeras_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # running from a source checkout: use the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def longtail_lengths(max_len: int, requests: int, seed: int) -> np.ndarray:
+    """Total tokens (prompt + generation) per request: 70% short
+    (4..max_len/4), 25% medium (..3/4), 5% pinned at max_len."""
+    rng = np.random.default_rng(seed)
+    kind = rng.choice(3, size=requests, p=(0.70, 0.25, 0.05))
+    short = rng.integers(4, max(5, max_len // 4), size=requests)
+    med = rng.integers(max_len // 4, max(max_len // 4 + 1, 3 * max_len // 4),
+                       size=requests)
+    return np.where(kind == 0, short,
+                    np.where(kind == 1, med, max_len)).astype(np.int64)
+
+
+def probe(model, page_size: int, lengths: np.ndarray, slots: int) -> dict:
+    """Rect-vs-paged budget math for one page size over one length mix."""
+    from distkeras_tpu.models.gpt import page_bytes
+
+    max_len = int(model.max_len)
+    if max_len % page_size:
+        raise ValueError(f"page_size {page_size} must divide "
+                         f"max_len {max_len}")
+    pb = page_bytes(model, page_size)
+    pages_per_slot = max_len // page_size
+    rect_per_slot = pages_per_slot * pb
+    pages = np.ceil(lengths / page_size).astype(np.int64)
+    paged_per_req = pages * pb
+    frag = pages * page_size - lengths  # idle cells in the last page
+    rect_budget = slots * rect_per_slot
+    slots_equiv = int(rect_budget // max(1, int(paged_per_req.mean())))
+    return {
+        "page_size": page_size,
+        "page_bytes": pb,
+        "pages_per_slot": pages_per_slot,
+        "rect_bytes_per_slot": rect_per_slot,
+        "paged_bytes_per_request_mean": float(paged_per_req.mean()),
+        "paged_pages_per_request_mean": float(pages.mean()),
+        "frag_tokens_per_request_mean": float(frag.mean()),
+        "frag_bytes_per_request": float(frag.mean()) * pb / page_size,
+        "rect_budget_bytes": rect_budget,
+        "slots_equiv": slots_equiv,
+        "slots_gain": slots_equiv / slots,
+    }
+
+
+def sweep(model, page_sizes, lengths: np.ndarray, slots: int) -> list:
+    return [probe(model, ps, lengths, slots) for ps in page_sizes]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--slots", type=int, default=64)
+    ap.add_argument("--page-sizes", default="8,16,32,64")
+    ap.add_argument("--requests", type=int, default=512)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from distkeras_tpu.models.gpt import gpt_tiny
+
+    model = gpt_tiny()
+    lengths = longtail_lengths(int(model.max_len), args.requests, args.seed)
+    base = {"bench": "paged_memory", "model": "gpt_tiny",
+            "max_len": int(model.max_len), "slots": args.slots,
+            "requests": args.requests, "seed": args.seed}
+    page_sizes = [int(s) for s in args.page_sizes.split(",") if s]
+    best = None
+    for row in sweep(model, page_sizes, lengths, args.slots):
+        print(json.dumps(dict(base, mode="probe", **row)))
+        if best is None or row["slots_equiv"] > best["slots_equiv"]:
+            best = row
+    print(json.dumps(dict(
+        base, mode="summary", best_page_size=best["page_size"],
+        best_slots_equiv=best["slots_equiv"],
+        best_slots_gain=best["slots_gain"])))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,6 +30,17 @@ phases (``--check phases --phases-baseline a.jsonl --phases-fresh b.jsonl``)
     window grew by more than ``--phase-budget`` (absolute frac) — "the
     regression is real AND it lives in commit, not compute".
 
+decode (``--check decode``)
+    Learns the serving-decode ladder from the committed
+    ``results/pr*_decode_bench.jsonl`` files (decode_bench.py rows) and
+    judges the newest one twice: against ABSOLUTE floors the serving
+    charter sets (continuous >= 3x naive; warm-prefix TTFT >= 2x
+    lower than cold; speculation > 1.0x useful-tokens/s — the
+    DESIGN.md §19 acceptance bars, held forever, not just at merge) and
+    against the prior file that carries the same metric, with the same
+    noise-band rule as ``fresh``. Older files that predate a metric
+    simply don't vote on it — absence is not a regression.
+
 Verdicts are JSONL rows ``{"kind": "verdict", "check": ..., "metric":
 ..., "status": "pass"|"fail", ...}`` written to ``--out`` (and stdout);
 the process exits 0 iff every verdict passed, so CI can gate on it::
@@ -39,6 +50,7 @@ the process exits 0 iff every verdict passed, so CI can gate on it::
     python benchmarks/regression_gate.py --check phases \
         --phases-baseline results/pr10_attribution.jsonl \
         --phases-fresh fresh_attribution.jsonl
+    python benchmarks/regression_gate.py --check decode
 """
 
 from __future__ import annotations
@@ -67,6 +79,26 @@ DEFAULT_LOOKBACK = 2
 #: quiet when two releases didn't touch the hot path at all)
 DEFAULT_NOISE_FLOOR = 0.005
 DEFAULT_PHASE_BUDGET = 0.02
+
+#: decode-bench row field -> gated metric name, keyed by the row's
+#: ``mode``. All higher-is-better by construction (ratios over the
+#: leg's own baseline, never raw wall clocks — CPU hosts are noisy).
+DECODE_METRICS = {
+    "continuous": (("tokens_per_s", "decode.tokens_per_s"),),
+    "summary": (("speedup_vs_naive", "decode.speedup_vs_naive"),),
+    "prefix": (("ttft_speedup", "decode.prefix.ttft_speedup"),),
+    "speculative": (("speedup_vs_plain", "decode.spec.speedup_vs_plain"),),
+    "longtail": (("hbm_ratio_rect_over_paged", "decode.paged.hbm_ratio"),),
+}
+
+#: absolute floors from the serving charter (ISSUE 9 / DESIGN.md §19
+#: acceptance). A ladder entry below its floor fails even with no
+#: history to compare against.
+DECODE_FLOORS = {
+    "decode.speedup_vs_naive": 3.0,
+    "decode.prefix.ttft_speedup": 2.0,
+    "decode.spec.speedup_vs_plain": 1.0,
+}
 
 
 # -- history loading --------------------------------------------------------
@@ -109,6 +141,39 @@ def noise_band(history: List[Tuple[int, dict]], metric: str,
     med = steps[mid] if len(steps) % 2 else (steps[mid - 1] +
                                              steps[mid]) / 2.0
     return max(med, floor)
+
+
+def load_decode_history(repo_dir: str = REPO) -> List[Tuple[int, dict]]:
+    """``[(pr_n, metrics_dict), ...]`` sorted by PR, from the committed
+    ``benchmarks/results/pr*_decode_bench.jsonl`` evidence files.
+    Metrics are extracted per DECODE_METRICS; a file contributes only
+    the metrics its rows carry (the pre-paging pr9 file has no prefix/
+    spec legs, and that's fine — it just doesn't vote on them)."""
+    out = []
+    pattern = os.path.join(repo_dir, "benchmarks", "results",
+                           "pr*_decode_bench.jsonl")
+    for path in sorted(glob.glob(pattern)):
+        m = re.search(r"pr(\d+)_decode_bench\.jsonl$", path)
+        if m is None:
+            continue
+        metrics: dict = {}
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    row = json.loads(line)
+                    for field, name in DECODE_METRICS.get(
+                            row.get("mode"), ()):
+                        if row.get(field) is not None:
+                            metrics[name] = row[field]
+        except (OSError, ValueError):
+            continue
+        if metrics:
+            out.append((int(m.group(1)), metrics))
+    out.sort(key=lambda t: t[0])
+    return out
 
 
 # -- checks -----------------------------------------------------------------
@@ -220,6 +285,53 @@ def judge_phases(baseline_jsonl: str, fresh_jsonl: str,
     return verdicts
 
 
+def judge_decode(history: List[Tuple[int, dict]],
+                 floors: dict = DECODE_FLOORS,
+                 noise_floor: float = DEFAULT_NOISE_FLOOR) -> List[dict]:
+    """Serving-decode ladder gate: newest evidence file vs the charter
+    floors AND vs its own history (per-metric sub-ladder, noise-banded
+    like ``fresh``)."""
+    if not history:
+        return [{"kind": "verdict", "check": "decode", "metric": "*",
+                 "status": "fail",
+                 "note": "no pr*_decode_bench.jsonl evidence committed"}]
+    n_new, newest = history[-1]
+    verdicts = []
+    for metric in sorted(newest):
+        vn = newest[metric]
+        floor = floors.get(metric)
+        if floor is not None:
+            status = "pass" if vn >= floor else "fail"
+            verdicts.append({
+                "kind": "verdict", "check": "decode", "metric": metric,
+                "release": n_new, "observed": vn, "floor": floor,
+                "status": status,
+                "note": (f"pr{n_new:02d} {metric} {vn:.3f} vs charter "
+                         f"floor {floor}")})
+        sub = [(n, m) for n, m in history if metric in m]
+        if len(sub) < 2:
+            continue
+        n_base, base = sub[-2]
+        vb = base[metric]
+        band = noise_band(sub, metric, floor=noise_floor)
+        delta = (vn - vb) / abs(vb)
+        status = "pass" if delta >= -band else "fail"
+        verdicts.append({
+            "kind": "verdict", "check": "decode", "metric": metric,
+            "baseline_release": n_base, "release": n_new,
+            "baseline": vb, "observed": vn,
+            "delta_frac": round(delta, 6), "noise_band": round(band, 6),
+            "status": status,
+            "note": (f"pr{n_base:02d}->pr{n_new:02d} {metric} "
+                     f"{vb:.3f} -> {vn:.3f} ({delta:+.2%}, noise band "
+                     f"±{band:.2%})")})
+    if not verdicts:
+        verdicts.append({"kind": "verdict", "check": "decode",
+                         "metric": "*", "status": "fail",
+                         "note": "evidence files carry no gated metrics"})
+    return verdicts
+
+
 # -- CLI --------------------------------------------------------------------
 
 def _emit(verdicts: List[dict], out_path: Optional[str]) -> int:
@@ -240,7 +352,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python benchmarks/regression_gate.py",
         description="Judge benchmark results against the committed "
                     "BENCH_r*.json release ladder; exit 1 on regression.")
-    ap.add_argument("--check", choices=("history", "fresh", "phases"),
+    ap.add_argument("--check",
+                    choices=("history", "fresh", "phases", "decode"),
                     default="history")
     ap.add_argument("--repo-dir", default=REPO,
                     help="directory holding BENCH_r*.json")
@@ -280,6 +393,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         verdicts = judge_fresh(load_history(args.repo_dir), fresh,
                                metrics=metrics,
                                noise_floor=args.noise_floor)
+    elif args.check == "decode":
+        verdicts = judge_decode(load_decode_history(args.repo_dir),
+                                noise_floor=args.noise_floor)
     else:
         if not (args.phases_baseline and args.phases_fresh):
             ap.error("--check phases requires --phases-baseline and "

@@ -49,6 +49,8 @@ _CONSUMER_PATHS = (
     "benchmarks/attribution.py",
     "benchmarks/regression_gate.py",
     "benchmarks/rollout_probe.py",
+    "benchmarks/decode_bench.py",
+    "benchmarks/paged_memory_probe.py",
     "distkeras_tpu/health/export.py",
     "distkeras_tpu/health/endpoints.py",
     "distkeras_tpu/health/slo.py",

@@ -91,8 +91,25 @@ def _fleet_versions(rows: list) -> dict:
     return out
 
 
+def _fleet_decode(rows: list) -> dict:
+    """Decode-plane gauges worth one glance in the fleet table: prefix-cache
+    hit rate and KV page occupancy (``serving.decode.prefix.hit_rate`` /
+    ``serving.decode.paged.page_occupancy``, DESIGN.md §19). Keys appear
+    only when an engine exports the gauge, so non-generative fleets pay
+    no extra line."""
+    out = {}
+    wanted = {"serving.decode.prefix.hit_rate": "prefix_hit_rate",
+              "serving.decode.paged.page_occupancy": "page_occupancy"}
+    for r in rows:
+        label = wanted.get(r.get("name"))
+        if label and r.get("kind") == "gauge":
+            out[label] = float(r.get("value", 0.0))
+    return out
+
+
 def _watch_table(workers: dict, prev: dict, interval: float,
-                 fleet_alerts: list = (), fleet_versions: dict = ()) -> str:
+                 fleet_alerts: list = (), fleet_versions: dict = (),
+                 fleet_decode: dict = ()) -> str:
     cols = ("worker", "hb_age", "windows", "win/s", "staleness",
             "degraded", "alerts", "flag")
     lines = [time.strftime("%H:%M:%S") + "  " +
@@ -117,6 +134,9 @@ def _watch_table(workers: dict, prev: dict, interval: float,
         skew = " SKEW" if len(set(fleet_versions.values())) > 1 else ""
         lines.append("          VERSIONS: " + ", ".join(
             f"{k}=v{v}" for k, v in sorted(fleet_versions.items())) + skew)
+    if fleet_decode:
+        lines.append("          DECODE: " + " ".join(
+            f"{k}={v:.2f}" for k, v in sorted(fleet_decode.items())))
     return "\n".join(lines)
 
 
@@ -244,7 +264,8 @@ def main(argv: Optional[list] = None) -> int:
                             workers, prev_windows,
                             args.interval if n else 0.0,
                             fleet_alerts=_fleet_alerts(rows),
-                            fleet_versions=_fleet_versions(rows)),
+                            fleet_versions=_fleet_versions(rows),
+                            fleet_decode=_fleet_decode(rows)),
                             flush=True)
                         prev_windows = {w: d.get("windows", 0)
                                         for w, d in workers.items()}

@@ -31,6 +31,22 @@ over ``max_len`` keys with an exact-zero tail, decode logits are
 bitwise-equal (f32) to the standard full forward evaluated at the same
 ``max_len`` padded shape (NUMERICS.md "Decode-step equivalence");
 cache mode requires ``attention="full"``.
+
+Paged decode mode (DESIGN.md §19): passing ``page_table`` alongside
+``cache`` switches the cache layout from one ``max_len`` row per batch
+row to a shared **page pool** — per layer ``{"k", "v"}`` arrays of
+``[num_pages + 1, page_size, heads, head_dim]`` (see
+:func:`init_paged_cache`; the last page is scratch) — with
+``page_table[b, j]`` naming the physical page that backs row ``b``'s
+logical token positions ``[j*page_size, (j+1)*page_size)``. The forward
+gathers each row's pages into a dense ``[batch, max_len, ...]`` view,
+places the in-call K/V block into that view, and runs the IDENTICAL
+fixed-length masked attention as the rectangular path — the view holds
+bitwise-the-same values at every unmasked position, so paged decode
+logits stay bitwise-equal to rectangular decode (asserted in
+tests/test_paged_generation.py). The new K/V block is then scattered to
+its physical page cells; positions past ``max_len`` (the ghost slot)
+and cells of unmapped table entries land in the scratch page.
 """
 
 from __future__ import annotations
@@ -57,7 +73,7 @@ class CausalSelfAttention(nn.Module):
     precision: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, cache=None, cache_index=None):
+    def __call__(self, x, cache=None, cache_index=None, page_table=None):
         dtype, dense_kw, _, _ = precision_lib.resolve(self.precision,
                                                       self.dtype)
         width = x.shape[-1]
@@ -74,11 +90,26 @@ class CausalSelfAttention(nn.Module):
             b, t = x.shape[:2]
             rows = jnp.arange(b)[:, None]
             pos = cache_index[:, None] + jnp.arange(t)[None, :]  # [b, t]
-            # mode="drop": a ghost position past max_len-1 (the decode
-            # step's gemm-path padding, DESIGN.md §14) must not clamp onto
-            # the last real cell
-            k_cache = cache["k"].at[rows, pos].set(k, mode="drop")
-            v_cache = cache["v"].at[rows, pos].set(v, mode="drop")
+            if page_table is not None:
+                # paged layout: gather each row's pages into the SAME
+                # dense [b, max_len, heads, head_dim] view the
+                # rectangular path attends over, so the attention below
+                # is shape- and value-identical (bitwise parity)
+                ps = cache["k"].shape[1]
+                pmax = page_table.shape[1]
+                max_len = pmax * ps
+                gather = lambda pages: pages[page_table].reshape(
+                    b, max_len, self.num_heads, head_dim)
+                k_cache = gather(cache["k"]).at[rows, pos].set(
+                    k, mode="drop")
+                v_cache = gather(cache["v"]).at[rows, pos].set(
+                    v, mode="drop")
+            else:
+                # mode="drop": a ghost position past max_len-1 (the decode
+                # step's gemm-path padding, DESIGN.md §14) must not clamp
+                # onto the last real cell
+                k_cache = cache["k"].at[rows, pos].set(k, mode="drop")
+                v_cache = cache["v"].at[rows, pos].set(v, mode="drop")
             # causal across history + block: key p visible to query j iff
             # p <= cache_index + j; masked keys get exact-zero softmax
             # weight (MASK_VALUE underflows), so the fixed-length
@@ -88,7 +119,22 @@ class CausalSelfAttention(nn.Module):
             out = dot_product_attention(q, k_cache, v_cache, mask=mask)
             out = out.reshape(out.shape[:2] + (width,))
             out = nn.Dense(width, dtype=dtype, name="out", **dense_kw)(out)
-            return out, {"k": k_cache, "v": v_cache}
+            if page_table is not None:
+                # scatter the in-call block to its PHYSICAL page cells.
+                # Ghost/overflow positions (>= max_len) and positions whose
+                # table entry is unmapped route to the scratch page (the
+                # pool keeps unmapped entries pointing there), so no live
+                # page is ever perturbed by padding.
+                scratch_page = cache["k"].shape[0] - 1
+                page_idx = jnp.clip(pos // ps, 0, pmax - 1)
+                phys = jnp.take_along_axis(page_table, page_idx, axis=1)
+                phys = jnp.where(pos < max_len, phys, scratch_page)
+                off = jnp.where(pos < max_len, pos % ps, 0)
+                new_cache = {"k": cache["k"].at[phys, off].set(k),
+                             "v": cache["v"].at[phys, off].set(v)}
+            else:
+                new_cache = {"k": k_cache, "v": v_cache}
+            return out, new_cache
         if self.attention == "ring":
             out = ring_attention(q, k, v, axis_name=self.axis_name,
                                  causal=True)
@@ -115,14 +161,15 @@ class DecoderBlock(nn.Module):
     precision: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, train: bool = False, cache=None, cache_index=None):
+    def __call__(self, x, train: bool = False, cache=None, cache_index=None,
+                 page_table=None):
         dtype = precision_lib.resolve(self.precision, self.dtype)[0]
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(dtype)
         attn = CausalSelfAttention(self.num_heads, self.dtype, self.attention,
                                    self.axis_name, precision=self.precision,
                                    name="attn")
         if cache is not None:
-            y, new_cache = attn(y, cache, cache_index)
+            y, new_cache = attn(y, cache, cache_index, page_table)
         else:
             y, new_cache = attn(y), None
         x = x + y
@@ -152,7 +199,7 @@ class CausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, train: bool = False, cache=None,
-                 cache_index=None):
+                 cache_index=None, page_table=None):
         dtype = precision_lib.resolve(self.precision, self.dtype)[0]
         ids = input_ids.astype(jnp.int32)
         b, t = ids.shape  # t = LOCAL block length under sequence parallelism
@@ -173,7 +220,8 @@ class CausalLM(nn.Module):
                     self.num_heads, self.mlp_dim, self.dtype,
                     self.attention, self.axis_name,
                     precision=self.precision, name=f"layer_{i}")(
-                        x, train, cache=cache[i], cache_index=cache_index)
+                        x, train, cache=cache[i], cache_index=cache_index,
+                        page_table=page_table)
                 new_cache.append(layer_cache)
             x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
             logits = nn.Dense(self.vocab_size, dtype=jnp.float32,
@@ -220,6 +268,33 @@ def init_cache(model: CausalLM, batch: int, dtype=None):
     shape = (batch, model.max_len, model.num_heads, head_dim)
     return tuple({"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
                  for _ in range(model.num_layers))
+
+
+def init_paged_cache(model: CausalLM, num_pages: int, page_size: int,
+                     dtype=None):
+    """Zeroed shared page pool for paged decode (DESIGN.md §19): a tuple
+    (one entry per layer) of ``{"k", "v"}`` arrays shaped
+    ``[num_pages + 1, page_size, num_heads, head_dim]``. One logical
+    page spans every layer (the same page id indexes each layer's
+    array), so a page costs :func:`page_bytes` of HBM. The extra LAST
+    page is **scratch**: unmapped page-table entries and ghost/overflow
+    writes point at it, mirroring the rectangular pool's scratch row."""
+    if dtype is None:
+        dtype = precision_lib.resolve(model.precision, model.dtype)[0]
+    head_dim = model.width // model.num_heads
+    shape = (num_pages + 1, page_size, model.num_heads, head_dim)
+    return tuple({"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+                 for _ in range(model.num_layers))
+
+
+def page_bytes(model: CausalLM, page_size: int, dtype=None) -> int:
+    """HBM bytes one logical page costs (k + v cells across every
+    layer) — the allocation unit the paged pool budgets in, replacing
+    the per-slot :func:`cache_bytes_per_row` rectangle."""
+    if dtype is None:
+        dtype = precision_lib.resolve(model.precision, model.dtype)[0]
+    return (2 * model.num_layers * page_size * model.width
+            * np.dtype(dtype).itemsize)
 
 
 def cache_bytes_per_row(model: CausalLM, dtype=None) -> int:

@@ -37,8 +37,14 @@ from distkeras_tpu.serving.engine import ServingEngine
 from distkeras_tpu.serving.generation import (
     GenerationEngine,
     GenerationResult,
+    ModelDraft,
+    NgramDraft,
 )
-from distkeras_tpu.serving.kv_cache import KVCachePool
+from distkeras_tpu.serving.kv_cache import (
+    KVCachePool,
+    PagedKVCachePool,
+    PrefixCache,
+)
 from distkeras_tpu.serving.rollout import (
     CanaryConfig,
     RolloutController,
@@ -55,6 +61,10 @@ __all__ = [
     "GenerationEngine",
     "GenerationResult",
     "KVCachePool",
+    "ModelDraft",
+    "NgramDraft",
+    "PagedKVCachePool",
+    "PrefixCache",
     "QueueFull",
     "Request",
     "RequestQueue",

@@ -37,8 +37,30 @@ bounded admission queue (:class:`QueueFull`, all-or-nothing), deadlines
 checked at admission AND between decode steps (:class:`DeadlineExceeded`
 mid-generation frees the slot), :class:`EngineClosed` after shutdown.
 
-Greedy (argmax) decoding only, on the host — sampling policies and
-paged attention are honest limits, DESIGN.md §14.
+Three opt-in decode accelerations (DESIGN.md §19) layer on top without
+changing any of the above:
+
+- ``page_size=``: the slot pool becomes a :class:`PagedKVCachePool` —
+  admission reserves only ``ceil((prompt + max_new) / page_size)``
+  pages instead of a ``max_len`` rectangle, with bitwise-identical
+  logits (the paged forward attends over the same dense gathered view).
+- ``prefix_cache_bytes=``: a host-RAM :class:`PrefixCache` keeps
+  content-hashed KV prefixes; a full hit emits the first token with
+  zero forward calls, a partial hit swaps the cached pages back in and
+  prefills only the suffix. A failed swap-in (the ``"kv.swap_in"``
+  chaos site) evicts the entry and degrades to a cold prefill.
+- ``draft=``/``spec_k=``: speculative decoding — the draft proposes k
+  tokens, one verify call scores them all, and the exact greedy
+  accept/reject rule (NUMERICS.md "Speculative accept/reject
+  exactness") emits a token stream identical to plain greedy decode
+  regardless of draft quality.
+
+All executables (prefill x buckets, decode/verify x ladder, page
+swap-in/out, draft prefill/decode) are still AOT-compiled in
+``__init__`` — the compile cache cannot grow under any traffic mix.
+
+Greedy (argmax) decoding only, on the host — sampling policies remain
+an honest limit, DESIGN.md §14.
 """
 
 from __future__ import annotations
@@ -55,7 +77,9 @@ from distkeras_tpu import telemetry
 from distkeras_tpu.serving.batching import (DeadlineExceeded, EngineClosed,
                                             QueueFull)
 from distkeras_tpu.serving.buckets import BucketSpec
-from distkeras_tpu.serving.kv_cache import KVCachePool
+from distkeras_tpu.serving.kv_cache import (KVCachePool, PagedKVCachePool,
+                                            PrefixCache)
+from distkeras_tpu.utils import fault
 
 #: token id fed at the decode step's ghost position (its output is
 #: discarded and its cache write dropped, so any valid id works)
@@ -129,6 +153,249 @@ def make_decode_fn(model):
     return decode
 
 
+def make_verify_fn(model):
+    """Pure ``(params, pool, slot_ids[n], tokens[n, T], lengths[n]) ->
+    (pool', logits[n, T, V])``: the speculative verify step over the
+    rectangular pool. Each lane feeds ``[pending, d_1 .. d_{T-1}]`` at
+    positions ``len .. len+T-1``; ALL T new K/V cells are scattered back
+    (accepted cells are exactly what sequential greedy would have
+    written; rejected cells sit past the post-accept length, masked and
+    overwritten before ever becoming visible) and all T logit rows
+    return for the host-side accept/reject walk. T >= 2 keeps the gemm
+    path, same as the decode ghost."""
+    import jax
+    import jax.numpy as jnp
+
+    def verify(params, pool, slot_ids, tokens, lengths):
+        n, t = tokens.shape
+        rows = jax.tree.map(lambda a: a[slot_ids], pool)
+        logits, new_rows = model.apply(
+            {"params": params}, tokens, cache=rows, cache_index=lengths)
+        lane = jnp.arange(n)[:, None]
+        pos = lengths[:, None] + jnp.arange(t)[None, :]
+        pool = jax.tree.map(
+            lambda p, c: p.at[slot_ids[:, None], pos].set(
+                c[lane, pos], mode="drop"), pool, new_rows)
+        return pool, logits
+
+    return verify
+
+
+def make_paged_step_fn(model):
+    """Pure ``(params, pages, page_tables[n, Pmax], tokens[n, T],
+    lengths[n]) -> (pages', logits[n, T, V])`` — the ONE compiled shape
+    family for every paged phase. Prefill is n=1/T=bucket at
+    ``lengths=[start]`` (start > 0 = suffix prefill after a prefix-cache
+    hit), decode is T=2 (token + ghost), verify is T=spec_k+1. The
+    model's paged write-back routes every cell to its physical page;
+    ghost/overflow cells land in the scratch page."""
+
+    def step(params, pages, page_tables, tokens, lengths):
+        logits, new_pages = model.apply(
+            {"params": params}, tokens, cache=pages, cache_index=lengths,
+            page_table=page_tables)
+        return new_pages, logits
+
+    return step
+
+
+def make_swap_out_fn():
+    """Pure ``(pages, page_ids[Pmax]) -> data``: gather the named pages
+    (per leaf ``[Pmax, page_size, heads, head_dim]``) for host parking.
+    NOT donating — the pool stays live; unused ids point at scratch."""
+    import jax
+
+    def swap_out(pages, page_ids):
+        return jax.tree.map(lambda a: a[page_ids], pages)
+
+    return swap_out
+
+
+def make_swap_in_fn():
+    """Pure ``(pages, page_ids[Pmax], data) -> pages'``: scatter parked
+    page data back into the (donated) pool. Unused ids point at scratch,
+    so their data rows collide only on the scratch page."""
+    import jax
+
+    def swap_in(pages, page_ids, data):
+        return jax.tree.map(lambda a, d: a.at[page_ids].set(d),
+                            pages, data)
+
+    return swap_in
+
+
+class NgramDraft:
+    """Prompt-lookup drafting (host-only, zero device cost): propose the
+    k tokens that followed the most recent earlier occurrence of the
+    context's final ``ngram``-gram. Great on repetitive/structured
+    output, useless on novel text — which is FINE: the verify step's
+    exact accept/reject makes draft quality a throughput knob, never a
+    correctness one. When no gram matches, the last token is repeated
+    (proposals must always be exactly k — the verify shape is fixed)."""
+
+    def __init__(self, ngram: int = 2):
+        if ngram < 1:
+            raise ValueError(f"ngram must be >= 1, got {ngram}")
+        self.ngram = int(ngram)
+        self._ctx: dict = {}
+
+    def bind(self, engine) -> None:  # noqa: ARG002 - uniform draft API
+        """No executables to compile; the draft is pure host work."""
+
+    def begin(self, slot: int, prompt, first_token: int) -> None:
+        self._ctx[slot] = [int(t) for t in prompt] + [int(first_token)]
+
+    def propose(self, slots, last_tokens, lengths, k: int) -> np.ndarray:
+        del last_tokens, lengths  # the host context already ends on them
+        out = np.zeros((len(slots), k), np.int32)
+        for i, s in enumerate(slots):
+            out[i] = self._propose_one(self._ctx[s], k)
+        return out
+
+    def _propose_one(self, ctx, k: int):
+        n = self.ngram
+        props: list = []
+        if len(ctx) > n:
+            tail = ctx[-n:]
+            for start in range(len(ctx) - n - 1, -1, -1):
+                if ctx[start:start + n] == tail:
+                    props = ctx[start + n:start + n + k]
+                    break
+        while len(props) < k:
+            props.append(props[-1] if props else ctx[-1])
+        return np.asarray(props[:k], np.int32)
+
+    def observe(self, slot: int, emitted) -> None:
+        self._ctx[slot].extend(int(t) for t in emitted)
+
+    def release(self, slot: int) -> None:
+        self._ctx.pop(slot, None)
+
+
+class ModelDraft:
+    """Draft-model speculative proposals: a smaller ``CausalLM`` runs
+    k+1 cheap decode steps to propose k tokens the target verifies in
+    one call. The draft keeps its OWN rectangular KV pool indexed by the
+    target's slot ids and always feeds at the target's lengths, so its
+    cache tracks the true (post-accept) token sequence wherever the
+    engine ran speculative iterations; iterations the engine gated off
+    (e.g. near ``max_len``) leave a stale draft cell behind, which can
+    only lower the accept rate — output exactness never depends on the
+    draft cache (NUMERICS.md "Speculative accept/reject exactness").
+
+    ``bind`` AOT-compiles one draft prefill per prompt bucket and one
+    draft decode per ladder entry against the draft pool's shapes —
+    fixed at construction, so the engine-wide compile-cache invariant
+    holds with a draft attached."""
+
+    def __init__(self, model, params, *, dtype=None):
+        self.model = model
+        self.params = params
+        self._dtype = dtype
+        self._cache = None
+
+    def bind(self, engine) -> None:
+        import jax
+
+        from distkeras_tpu.models import gpt as gpt_lib
+
+        if int(self.model.max_len) < engine.max_len:
+            raise ValueError(
+                f"draft max_len {self.model.max_len} < target max_len "
+                f"{engine.max_len}; the draft must cover every position "
+                f"the target can reach")
+        self._buckets = engine._buckets
+        self._ladder = engine._ladder
+        self._scratch = engine.pool.num_slots
+        if engine._device is not None:
+            self.params = jax.device_put(self.params, engine._device)
+        cache = gpt_lib.init_cache(self.model, engine.pool.num_slots + 1,
+                                   self._dtype)
+        if engine._device is not None:
+            cache = jax.device_put(cache, engine._device)
+        self._cache = cache
+        self._lengths = np.zeros(engine.pool.num_slots + 1, np.int32)
+        sds = lambda tree: jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+        p_sds, c_sds = sds(self.params), sds(self._cache)
+        i32 = lambda *shape: jax.ShapeDtypeStruct(shape, np.int32)
+        prefill = make_prefill_fn(self.model)
+        decode = make_decode_fn(self.model)
+        self._prefill_exec = {}
+        self._decode_exec = {}
+        for lb in self._buckets:
+            with telemetry.span("serving.decode.compile", draft_prefill=lb):
+                self._prefill_exec[lb] = jax.jit(
+                    prefill, donate_argnums=(1,)).lower(
+                        p_sds, c_sds, i32(1, lb), i32(), i32()).compile()
+            telemetry.counter("serving.decode.compiles").inc()
+        for n in self._ladder:
+            with telemetry.span("serving.decode.compile", draft_lanes=n):
+                self._decode_exec[n] = jax.jit(
+                    decode, donate_argnums=(1,)).lower(
+                        p_sds, c_sds, i32(n), i32(n), i32(n)).compile()
+            telemetry.counter("serving.decode.compiles").inc()
+        # warm every executable against the draft scratch row
+        scratch = np.int32(self._scratch)
+        for lb, ex in self._prefill_exec.items():
+            self._cache, _ = ex(self.params, self._cache,
+                                np.zeros((1, lb), np.int32), scratch,
+                                np.int32(lb))
+        for n, ex in self._decode_exec.items():
+            lanes = np.full(n, scratch, np.int32)
+            zeros = np.zeros(n, np.int32)
+            self._cache, _ = ex(self.params, self._cache, lanes, zeros,
+                                zeros)
+
+    @property
+    def compiled_executables(self):
+        return {"prefill": tuple(sorted(self._prefill_exec)),
+                "decode": tuple(sorted(self._decode_exec))}
+
+    def begin(self, slot: int, prompt, first_token: int) -> None:
+        del first_token  # arrives as last_tokens at the next propose
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        n = prompt.size
+        lb = self._buckets.bucket_for(n)
+        ids = np.zeros((1, lb), np.int32)
+        ids[0, :n] = prompt
+        self._cache, _ = self._prefill_exec[lb](
+            self.params, self._cache, ids, np.int32(slot), np.int32(n))
+        self._lengths[slot] = n
+
+    def propose(self, slots, last_tokens, lengths, k: int) -> np.ndarray:
+        n = len(slots)
+        lane = self._ladder.bucket_for(n)
+        out = np.zeros((n, k), np.int32)
+        feed = np.asarray(last_tokens, np.int32).copy()
+        lens_live = np.asarray(lengths, np.int32).copy()
+        # k proposal feeds + one cache-fill feed for the last draft
+        # token, so a full accept leaves the draft cache complete
+        for step in range(k + 1):
+            slot_ids = np.full(lane, self._scratch, np.int32)
+            toks = np.full(lane, GHOST_TOKEN, np.int32)
+            lens = np.zeros(lane, np.int32)
+            slot_ids[:n] = slots
+            toks[:n] = feed
+            lens[:n] = lens_live
+            self._cache, logits = self._decode_exec[lane](
+                self.params, self._cache, slot_ids, toks, lens)
+            lens_live += 1
+            if step < k:
+                feed = np.argmax(np.asarray(logits)[:n], axis=-1)
+                feed = feed.astype(np.int32)
+                out[:, step] = feed
+        self._lengths[list(slots)] = lens_live
+        return out
+
+    def observe(self, slot: int, emitted) -> None:
+        """The draft feeds at the target's lengths, so acceptance needs
+        no rollback bookkeeping here."""
+
+    def release(self, slot: int) -> None:
+        self._lengths[slot] = 0
+
+
 class GenerationResult:
     """Terminal value of a finished generation.
 
@@ -151,7 +418,7 @@ class GenerationResult:
 class _GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "eos_id", "stream", "future",
                  "t_submit", "deadline", "generated", "last_token",
-                 "trace", "t_perf")
+                 "last_logits", "trace", "t_perf")
 
     def __init__(self, prompt, max_new_tokens, eos_id, stream,
                  t_submit, deadline, trace=None):
@@ -164,6 +431,10 @@ class _GenRequest:
         self.deadline = deadline
         self.generated: list = []
         self.last_token: int = 0
+        #: logits row that produced the newest token (kept only when a
+        #: prefix cache is attached — retirement parks them so a resumed
+        #: conversation's full hit can emit with zero forwards)
+        self.last_logits = None
         #: TraceContext this request's spans chain under (None = untraced);
         #: t_perf is the submit instant on the span time base
         #: (perf_counter — t_submit stays monotonic for deadline math)
@@ -192,7 +463,11 @@ class GenerationEngine:
                  default_max_new_tokens: int = 32,
                  eos_id: Optional[int] = None,
                  device=None, dtype=None, hbm_fraction: float = 0.8,
-                 warmup: bool = True):
+                 warmup: bool = True,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 prefix_cache_bytes: int = 0,
+                 draft=None, spec_k: int = 0):
         import jax
 
         self.model = model
@@ -215,8 +490,28 @@ class GenerationEngine:
                 f"slot ladder {self._ladder.sizes} must top out at "
                 f"num_slots={num_slots} so every in-flight count has a "
                 f"compiled lane width")
-        self.pool = KVCachePool(model, num_slots, device=device,
-                                dtype=dtype, hbm_fraction=hbm_fraction)
+        self._paged = page_size is not None
+        if prefix_cache_bytes and not self._paged:
+            raise ValueError(
+                "prefix_cache_bytes requires page_size: the prefix cache "
+                "parks/restores KV at page granularity")
+        if (draft is None) != (spec_k == 0):
+            raise ValueError(
+                "speculative decoding needs BOTH draft= and spec_k >= 1")
+        if spec_k < 0 or spec_k >= self.max_len - 1:
+            raise ValueError(f"spec_k must be in [0, max_len-1), got "
+                             f"{spec_k}")
+        self._draft = draft
+        self._spec_k = int(spec_k)
+        if self._paged:
+            self.pool = PagedKVCachePool(
+                model, num_slots, page_size=page_size, num_pages=num_pages,
+                device=device, dtype=dtype, hbm_fraction=hbm_fraction)
+        else:
+            self.pool = KVCachePool(model, num_slots, device=device,
+                                    dtype=dtype, hbm_fraction=hbm_fraction)
+        self._prefix = (PrefixCache(prefix_cache_bytes)
+                        if prefix_cache_bytes else None)
         if device is not None:
             params = jax.device_put(params, device)
         self._device = device
@@ -253,8 +548,25 @@ class GenerationEngine:
         self._tps_g = telemetry.gauge("serving.decode.tokens_per_s")
         self._active_g = telemetry.gauge("serving.decode.slots_active")
         self._depth_g = telemetry.gauge("serving.decode.queue_depth")
+        self._spec_proposed_c = telemetry.counter(
+            "serving.decode.spec.proposed")
+        self._spec_accepted_c = telemetry.counter(
+            "serving.decode.spec.accepted")
+        self._spec_iters_c = telemetry.counter(
+            "serving.decode.spec.iterations")
+        self._spec_rate_g = telemetry.gauge("serving.decode.spec.accept_rate")
+        self._swapped_in_c = telemetry.counter(
+            "serving.decode.paged.swapped_in")
+        self._swapped_out_c = telemetry.counter(
+            "serving.decode.paged.swapped_out")
+        self._swap_fail_c = telemetry.counter(
+            "serving.decode.paged.swap_in_failures")
+        self._prefix_full_c = telemetry.counter(
+            "serving.decode.prefix.full_hits")
 
         self._compile_all()
+        if self._draft is not None:
+            self._draft.bind(self)
         if warmup:
             self._warmup()
         self._thread = threading.Thread(target=self._scheduler_loop,
@@ -265,38 +577,122 @@ class GenerationEngine:
     # -- AOT compilation ---------------------------------------------------
 
     def _compile_all(self) -> None:
-        """Compile exactly one executable per prefill bucket and one per
-        slot-ladder entry, up front. Nothing compiles after __init__ —
-        the cache cannot grow under traffic (asserted by test)."""
+        """Compile exactly one executable per prefill bucket, one per
+        slot-ladder entry, one verify per ladder entry (speculative
+        only), and the fixed-shape page swap pair (prefix cache only),
+        up front. Nothing compiles after __init__ — the cache cannot
+        grow under traffic (asserted by test)."""
         import jax
 
         sds = lambda tree: jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
         p_sds, pool_sds = sds(self._params), sds(self.pool.pool)
         i32 = lambda *shape: jax.ShapeDtypeStruct(shape, np.int32)
-        prefill = make_prefill_fn(self.model)
-        decode = make_decode_fn(self.model)
+        compiles = telemetry.counter("serving.decode.compiles")
         self._prefill_exec = {}
         self._decode_exec = {}
+        self._verify_exec = {}
+        self._swap_out_exec = None
+        self._swap_in_exec = None
+        if self._paged:
+            step = make_paged_step_fn(self.model)
+            pmax = self.pool.pages_per_slot
+            for lb in self._buckets:
+                with telemetry.span("serving.decode.compile", prefill=lb):
+                    self._prefill_exec[lb] = jax.jit(
+                        step, donate_argnums=(1,)).lower(
+                            p_sds, pool_sds, i32(1, pmax), i32(1, lb),
+                            i32(1)).compile()
+                compiles.inc()
+            for n in self._ladder:
+                with telemetry.span("serving.decode.compile", lanes=n):
+                    self._decode_exec[n] = jax.jit(
+                        step, donate_argnums=(1,)).lower(
+                            p_sds, pool_sds, i32(n, pmax), i32(n, 2),
+                            i32(n)).compile()
+                compiles.inc()
+                if self._spec_k:
+                    with telemetry.span("serving.decode.compile",
+                                        verify=n):
+                        self._verify_exec[n] = jax.jit(
+                            step, donate_argnums=(1,)).lower(
+                                p_sds, pool_sds, i32(n, pmax),
+                                i32(n, self._spec_k + 1), i32(n)).compile()
+                    compiles.inc()
+            if self._prefix is not None:
+                data_sds = jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(
+                        (pmax,) + a.shape[1:], a.dtype), pool_sds)
+                with telemetry.span("serving.decode.compile",
+                                    swap="out"):
+                    self._swap_out_exec = jax.jit(
+                        make_swap_out_fn()).lower(
+                            pool_sds, i32(pmax)).compile()
+                compiles.inc()
+                with telemetry.span("serving.decode.compile", swap="in"):
+                    self._swap_in_exec = jax.jit(
+                        make_swap_in_fn(), donate_argnums=(0,)).lower(
+                            pool_sds, i32(pmax), data_sds).compile()
+                compiles.inc()
+            return
+        prefill = make_prefill_fn(self.model)
+        decode = make_decode_fn(self.model)
         for lb in self._buckets:
             with telemetry.span("serving.decode.compile", prefill=lb):
                 self._prefill_exec[lb] = jax.jit(
                     prefill, donate_argnums=(1,)).lower(
                         p_sds, pool_sds, i32(1, lb), i32(), i32()).compile()
-            telemetry.counter("serving.decode.compiles").inc()
+            compiles.inc()
         for n in self._ladder:
             with telemetry.span("serving.decode.compile", lanes=n):
                 self._decode_exec[n] = jax.jit(
                     decode, donate_argnums=(1,)).lower(
                         p_sds, pool_sds, i32(n), i32(n), i32(n)).compile()
-            telemetry.counter("serving.decode.compiles").inc()
+            compiles.inc()
+            if self._spec_k:
+                with telemetry.span("serving.decode.compile", verify=n):
+                    self._verify_exec[n] = jax.jit(
+                        make_verify_fn(self.model),
+                        donate_argnums=(1,)).lower(
+                            p_sds, pool_sds, i32(n),
+                            i32(n, self._spec_k + 1), i32(n)).compile()
+                compiles.inc()
 
     def _warmup(self) -> None:
-        """Run every executable once against the scratch slot so no
+        """Run every executable once against the scratch slot/page so no
         request pays first-execution costs. Scratch garbage is fine:
         reads are masked by per-slot lengths."""
         with telemetry.span("serving.decode.warmup"):
             scratch = np.int32(self.pool.scratch_slot)
+            if self._paged:
+                pmax = self.pool.pages_per_slot
+                spt = self.pool.page_tables[self.pool.scratch_slot]
+                for lb, ex in self._prefill_exec.items():
+                    new_pool, _ = ex(self._params, self.pool.pool,
+                                     spt[None, :],
+                                     np.zeros((1, lb), np.int32),
+                                     np.zeros(1, np.int32))
+                    self.pool.swap(new_pool)
+                for n, ex in self._decode_exec.items():
+                    pts = np.tile(spt, (n, 1))
+                    zeros = np.zeros(n, np.int32)
+                    new_pool, _ = ex(self._params, self.pool.pool, pts,
+                                     np.zeros((n, 2), np.int32), zeros)
+                    self.pool.swap(new_pool)
+                for n, ex in self._verify_exec.items():
+                    pts = np.tile(spt, (n, 1))
+                    zeros = np.zeros(n, np.int32)
+                    new_pool, _ = ex(
+                        self._params, self.pool.pool, pts,
+                        np.zeros((n, self._spec_k + 1), np.int32), zeros)
+                    self.pool.swap(new_pool)
+                if self._swap_out_exec is not None:
+                    ids = np.full(pmax, self.pool.scratch_page, np.int32)
+                    data = self._swap_out_exec(self.pool.pool, ids)
+                    new_pool = self._swap_in_exec(self.pool.pool, ids,
+                                                  data)
+                    self.pool.swap(new_pool)
+                return
             for lb, ex in self._prefill_exec.items():
                 new_pool, _ = ex(self._params, self.pool.pool,
                                  np.zeros((1, lb), np.int32), scratch,
@@ -308,14 +704,34 @@ class GenerationEngine:
                 new_pool, _ = ex(self._params, self.pool.pool, lanes,
                                  zeros, zeros)
                 self.pool.swap(new_pool)
+            for n, ex in self._verify_exec.items():
+                lanes = np.full(n, scratch, np.int32)
+                zeros = np.zeros(n, np.int32)
+                new_pool, _ = ex(self._params, self.pool.pool, lanes,
+                                 np.zeros((n, self._spec_k + 1), np.int32),
+                                 zeros)
+                self.pool.swap(new_pool)
 
     @property
     def compiled_executables(self):
         """{"prefill": bucket sizes, "decode": lane widths} actually
         compiled — tests assert this equals the declared ladders and
-        never grows."""
-        return {"prefill": tuple(sorted(self._prefill_exec)),
-                "decode": tuple(sorted(self._decode_exec))}
+        never grows. Optional features add their own (equally fixed)
+        keys: "verify" lane widths under speculative decoding, "swap"
+        under the prefix cache, "draft_prefill"/"draft_decode" with a
+        :class:`ModelDraft` attached."""
+        execs = {"prefill": tuple(sorted(self._prefill_exec)),
+                 "decode": tuple(sorted(self._decode_exec))}
+        if self._verify_exec:
+            execs["verify"] = tuple(sorted(self._verify_exec))
+        if self._swap_in_exec is not None:
+            execs["swap"] = ("in", "out")
+        if self._draft is not None and hasattr(self._draft,
+                                               "compiled_executables"):
+            de = self._draft.compiled_executables
+            execs["draft_prefill"] = de["prefill"]
+            execs["draft_decode"] = de["decode"]
+        return execs
 
     # -- live weight rollout (serving/rollout.py, DESIGN.md §18) -----------
 
@@ -541,7 +957,21 @@ class GenerationEngine:
                     req.trace, "trace.queue_wait", req.t_perf,
                     time.perf_counter() - req.t_perf)
             slot = self.pool.allocate()
-            self._prefill(req, slot)
+            if self._paged and not self.pool.reserve(
+                    slot, min(req.prompt.size + req.max_new_tokens,
+                              self.max_len)):
+                # page exhaustion: the paged pool's backpressure. Leave
+                # the request at the queue head — retiring sequences
+                # return pages and the next iteration retries.
+                self.pool.free(slot)
+                with self._cv:
+                    self._dq.appendleft(req)
+                    self._depth_g.set(len(self._dq))
+                return
+            if self._paged:
+                self._prefill_paged(req, slot)
+            else:
+                self._prefill(req, slot)
             self._admitted_c.inc()
             if self._emit(req, slot) is None:
                 active[slot] = req
@@ -574,7 +1004,112 @@ class GenerationEngine:
                 model_version=self.model_version)
         req.generated.append(tok)
         req.last_token = tok
+        if self._draft is not None:
+            self._draft.begin(slot, req.prompt, tok)
         self._stream_token(req, tok)
+
+    def _prefill_paged(self, req: _GenRequest, slot: int) -> None:
+        """Paged admission: prefix-cache lookup, page swap-in, then a
+        suffix (or full) prefill of whatever the cache didn't cover. A
+        full hit with parked logits emits the first token with ZERO
+        forward calls."""
+        n = req.prompt.size
+        t0 = time.monotonic()
+        tp0 = time.perf_counter()
+        entry = (self._prefix.lookup(req.prompt)
+                 if self._prefix is not None else None)
+        start = 0
+        if entry is not None and self._swap_in_entry(slot, entry):
+            start = entry.length
+        else:
+            entry = None
+        logits_row = None
+        if entry is not None and start == n:
+            if entry.last_logits is not None:
+                # full hit: the parked logits ARE the first-token
+                # distribution — no device math at all
+                logits_row = entry.last_logits
+                self._prefix_full_c.inc()
+            else:
+                # KV covers the prompt but the logits weren't parked;
+                # re-derive them by re-feeding the final prompt token
+                start = n - 1
+        self.pool.lengths[slot] = start
+        ran_prefill = logits_row is None
+        if ran_prefill:
+            suffix = req.prompt[start:]
+            lb = self._buckets.bucket_for(suffix.size)
+            ids = np.zeros((1, lb), np.int32)
+            ids[0, :suffix.size] = req.prompt[start:]
+            pts = self.pool.page_table_row(slot)[None, :]
+            new_pool, logits = self._prefill_exec[lb](
+                self._params, self.pool.pool, pts, ids,
+                np.full(1, start, np.int32))
+            self.pool.swap(new_pool)
+            logits_row = np.asarray(logits)[0, n - start - 1]
+        self._slot_version[slot] = self.model_version
+        self.pool.lengths[slot] = n
+        tok = int(np.argmax(logits_row))
+        now = time.monotonic()
+        if ran_prefill:
+            self._prefills_c.inc()
+            self._prefill_h.record(now - t0)
+        self._ttft_h.record(now - req.t_submit)
+        if req.trace is not None:
+            telemetry.record_trace_span(
+                req.trace, "trace.prefill", tp0,
+                time.perf_counter() - tp0, slot=slot,
+                prefix_hit=entry is not None,
+                model_version=self.model_version)
+        req.generated.append(tok)
+        req.last_token = tok
+        if self._prefix is not None:
+            req.last_logits = np.asarray(logits_row).copy()
+            if entry is None or entry.length < n:
+                self._capture_prefix(slot, req.prompt, req.last_logits)
+        if self._draft is not None:
+            self._draft.begin(slot, req.prompt, tok)
+        self._stream_token(req, tok)
+
+    def _swap_in_entry(self, slot: int, entry) -> bool:
+        """Restore a parked prefix's pages into ``slot``'s reservation.
+        The ``"kv.swap_in"`` chaos site models a torn/lost host restore:
+        on failure the entry is evicted (never offered again) and the
+        caller cold-prefills — a degraded path, not a corrupted lane."""
+        import jax
+
+        if fault.chaos("kv.swap_in") is not None:
+            self._swap_fail_c.inc()
+            self._prefix.evict(entry)
+            return False
+        pmax = self.pool.pages_per_slot
+        p0 = self.pool.pages_for(entry.length)
+        page_ids = np.full(pmax, self.pool.scratch_page, np.int32)
+        page_ids[:p0] = self.pool.page_table_row(slot)[:p0]
+        pad = lambda a: (a if a.shape[0] == pmax else np.concatenate(
+            [a, np.zeros((pmax - a.shape[0],) + a.shape[1:], a.dtype)]))
+        data = jax.tree.map(pad, entry.data)
+        new_pool = self._swap_in_exec(self.pool.pool, page_ids, data)
+        self.pool.swap(new_pool)
+        self._swapped_in_c.inc(p0)
+        return True
+
+    def _capture_prefix(self, slot: int, tokens, last_logits) -> None:
+        """Park ``slot``'s first ``len(tokens)`` cells in the prefix
+        cache (compiled swap_out gather; the pool is NOT donated)."""
+        import jax
+
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if self._prefix.has(tokens):
+            return
+        pmax = self.pool.pages_per_slot
+        p0 = self.pool.pages_for(tokens.size)
+        page_ids = np.full(pmax, self.pool.scratch_page, np.int32)
+        page_ids[:p0] = self.pool.page_table_row(slot)[:p0]
+        data = self._swap_out_exec(self.pool.pool, page_ids)
+        data = jax.tree.map(lambda a: np.asarray(a)[:p0].copy(), data)
+        self._swapped_out_c.inc(p0)
+        self._prefix.insert(tokens, data, last_logits)
 
     def _decode_step(self, active) -> None:
         """One scheduler iteration of decode. Slots are grouped BY PINNED
@@ -594,28 +1129,55 @@ class GenerationEngine:
             telemetry.histogram("rollout.version_groups").record(
                 len(groups))
         for version in sorted(groups):
-            self._decode_group(active, groups[version], version)
+            slots = groups[version]
+            if self._spec_k and all(
+                    self.pool.lengths[s] + self._spec_k < self.max_len
+                    for s in slots):
+                # speculative iteration: safe only when every lane's
+                # verify block [len, len+spec_k] stays inside the
+                # context window; the tail of a sequence falls back to
+                # plain decode (exactness is unaffected either way)
+                self._spec_group(active, slots, version)
+            else:
+                self._decode_group(active, slots, version)
         self._reclaim_versions()
         self._active_g.set(len(active))
+
+    def _group_arrays(self, active, slots, lane: int, t: int):
+        """Ladder-padded step inputs: scratch lanes for padding, column
+        0 = each lane's pending token, columns 1..t-1 = GHOST (the
+        speculative path overwrites them with draft proposals)."""
+        scratch = self.pool.scratch_slot
+        slot_ids = np.full(lane, scratch, np.int32)
+        tokens = np.full((lane, t), GHOST_TOKEN, np.int32)
+        lengths = np.zeros(lane, np.int32)
+        for i, s in enumerate(slots):
+            slot_ids[i] = s
+            tokens[i, 0] = active[s].last_token
+            lengths[i] = self.pool.lengths[s]
+        return slot_ids, tokens, lengths
+
+    def _page_tables_for(self, slot_ids) -> np.ndarray:
+        return self.pool.page_tables[slot_ids]
 
     def _decode_group(self, active, slots, version: int) -> None:
         params = self._versions.get(version, self._params)
         n = len(slots)
         lane = self._ladder.bucket_for(n)
-        scratch = self.pool.scratch_slot
-        slot_ids = np.full(lane, scratch, np.int32)
-        tokens = np.full(lane, GHOST_TOKEN, np.int32)
-        lengths = np.zeros(lane, np.int32)
-        for i, s in enumerate(slots):
-            slot_ids[i] = s
-            tokens[i] = active[s].last_token
-            lengths[i] = self.pool.lengths[s]
+        slot_ids, tokens, lengths = self._group_arrays(active, slots,
+                                                       lane, 2)
         t0 = time.monotonic()
         tp0 = time.perf_counter()
-        new_pool, logits = self._decode_exec[lane](
-            params, self.pool.pool, slot_ids, tokens, lengths)
+        if self._paged:
+            new_pool, logits = self._decode_exec[lane](
+                params, self.pool.pool, self._page_tables_for(slot_ids),
+                tokens, lengths)
+            logits = np.asarray(logits)[:, 0, :]
+        else:
+            new_pool, logits = self._decode_exec[lane](
+                params, self.pool.pool, slot_ids, tokens[:, 0], lengths)
+            logits = np.asarray(logits)  # blocks until the step lands
         self.pool.swap(new_pool)
-        logits = np.asarray(logits)  # blocks until the step lands
         dt = time.monotonic() - t0
         dt_p = time.perf_counter() - tp0
         self._steps_c.inc()
@@ -630,6 +1192,10 @@ class GenerationEngine:
             tok = int(np.argmax(logits[i]))
             req.generated.append(tok)
             req.last_token = tok
+            if self._prefix is not None:
+                req.last_logits = logits[i].copy()
+            if self._draft is not None:
+                self._draft.observe(s, (tok,))
             if req.trace is not None:
                 # one decode iteration serves every lane at once, so each
                 # traced request gets a child span with the SHARED step
@@ -644,6 +1210,79 @@ class GenerationEngine:
             if reason is not None:
                 del active[s]
 
+    def _spec_group(self, active, slots, version: int) -> None:
+        """One draft-verify iteration: the draft proposes ``spec_k``
+        tokens per lane, ONE verify call scores every proposal, and the
+        exact greedy accept/reject rule walks each lane's logits — token
+        i+1 is emitted iff proposals 1..i all matched what greedy would
+        have produced, plus the one free token the verify call always
+        yields. Output is token-for-token what sequential greedy decode
+        emits (NUMERICS.md "Speculative accept/reject exactness")."""
+        params = self._versions.get(version, self._params)
+        n = len(slots)
+        s = self._spec_k
+        lane = self._ladder.bucket_for(n)
+        slot_ids, tokens, lengths = self._group_arrays(active, slots,
+                                                       lane, s + 1)
+        props = self._draft.propose(
+            slots, tokens[:n, 0], lengths[:n], s)
+        tokens[:n, 1:] = props
+        t0 = time.monotonic()
+        tp0 = time.perf_counter()
+        if self._paged:
+            new_pool, logits = self._verify_exec[lane](
+                params, self.pool.pool, self._page_tables_for(slot_ids),
+                tokens, lengths)
+        else:
+            new_pool, logits = self._verify_exec[lane](
+                params, self.pool.pool, slot_ids, tokens, lengths)
+        self.pool.swap(new_pool)
+        logits = np.asarray(logits)  # [lane, s+1, V]
+        greedy = np.argmax(logits, axis=-1)  # [lane, s+1]
+        dt = time.monotonic() - t0
+        dt_p = time.perf_counter() - tp0
+        self._steps_c.inc()
+        self._step_h.record(dt)
+        self._padded_h.record(lane - n)
+        self._spec_iters_c.inc()
+        emitted_total = 0
+        for i, slot in enumerate(slots):
+            req = active[slot]
+            m = 0
+            while m < s and props[i, m] == greedy[i, m]:
+                m += 1
+            emit = [int(t) for t in greedy[i, :m + 1]]
+            # caps: never emit past max_new_tokens, truncate at EOS
+            emit = emit[:req.max_new_tokens - len(req.generated)]
+            if req.eos_id is not None and req.eos_id in emit:
+                emit = emit[:emit.index(req.eos_id) + 1]
+            p = len(emit)
+            self._spec_proposed_c.inc(s)
+            self._spec_accepted_c.inc(p - 1)
+            self.pool.lengths[slot] += p  # cells L..L+p-1 are now true
+            for tok in emit:
+                req.generated.append(tok)
+                req.last_token = tok
+                self._stream_token(req, tok)
+            if self._prefix is not None:
+                req.last_logits = logits[i, p - 1].copy()
+            self._draft.observe(slot, emit)
+            emitted_total += p
+            if req.trace is not None:
+                telemetry.record_trace_span(
+                    req.trace, "trace.decode", tp0, dt_p,
+                    step=len(req.generated), lanes=lane, spec=p,
+                    model_version=version)
+            reason = self._emit(req, slot)
+            if reason is not None:
+                del active[slot]
+        self._tokens_c.inc(emitted_total)
+        if dt > 0:
+            self._tps_g.set(emitted_total / dt)
+        prop = self._spec_proposed_c.value
+        if prop:
+            self._spec_rate_g.set(self._spec_accepted_c.value / prop)
+
     def _emit(self, req: _GenRequest, slot: int) -> Optional[str]:
         """After a token lands, decide retirement. Returns the reason
         when the sequence finished (slot already freed), else None."""
@@ -657,8 +1296,20 @@ class GenerationEngine:
             reason = "max_len"
         else:
             return None
+        if (self._prefix is not None and req.last_logits is not None
+                and len(req.generated) > 1):
+            # park the finished conversation: cells [0, lengths) hold
+            # prompt + generated[:-1], and last_logits reproduces the
+            # final token — a resumed conversation becomes a full hit
+            self._capture_prefix(
+                slot,
+                np.concatenate([req.prompt,
+                                np.asarray(req.generated[:-1], np.int32)]),
+                req.last_logits)
         self.pool.free(slot)
         self._slot_version.pop(slot, None)  # unpin: version may reclaim
+        if self._draft is not None:
+            self._draft.release(slot)
         telemetry.counter("serving.decode.retired", reason=reason).inc()
         if req.trace is not None:
             telemetry.record_trace_span(
@@ -679,6 +1330,8 @@ class GenerationEngine:
                 del active[slot]
                 self.pool.free(slot)
                 self._slot_version.pop(slot, None)
+                if self._draft is not None:
+                    self._draft.release(slot)
                 self._expired_c.inc()
                 telemetry.counter("serving.decode.retired",
                                   reason="deadline").inc()
@@ -709,7 +1362,7 @@ class GenerationEngine:
             oldest = (time.monotonic() - self._dq[0].t_submit
                       if self._dq else 0.0)
         self._depth_g.set(depth)
-        return {
+        status = {
             "num_slots": self.pool.num_slots,
             "slots_active": self.pool.num_active,
             "slots_free": self.pool.num_free,
@@ -724,6 +1377,35 @@ class GenerationEngine:
             "last_swap_time": self.last_swap_time,
             "live_versions": sorted(self._versions),
         }
+        if self._paged:
+            status["paged"] = {
+                "page_size": self.pool.page_size,
+                "num_pages": self.pool.num_pages,
+                "pages_in_use": self.pool.pages_in_use,
+                "page_occupancy": (self.pool.pages_in_use
+                                   / self.pool.num_pages),
+                "page_bytes": self.pool.page_bytes,
+            }
+        if self._prefix is not None:
+            status["prefix_cache"] = {
+                "entries": len(self._prefix),
+                "bytes": self._prefix.bytes,
+                "budget_bytes": self._prefix.budget_bytes,
+                "hits": self._prefix.hits,
+                "misses": self._prefix.misses,
+                "hit_rate": self._prefix.hit_rate,
+                "evictions": self._prefix.evictions,
+            }
+        if self._spec_k:
+            proposed = self._spec_proposed_c.value
+            accepted = self._spec_accepted_c.value
+            status["speculative"] = {
+                "spec_k": self._spec_k,
+                "proposed": proposed,
+                "accepted": accepted,
+                "accept_rate": accepted / proposed if proposed else 0.0,
+            }
+        return status
 
     def shutdown(self, drain: bool = True, timeout: float = 60.0) -> None:
         with self._cv:

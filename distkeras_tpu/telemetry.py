@@ -196,6 +196,24 @@ METRIC_NAMES = {
     "serving.decode.tokens": "counter",
     "serving.decode.tokens_per_s": "gauge",
     "serving.decode.ttft_s": "histogram",
+    # planet-scale decode layer (DESIGN.md §19): prefix cache, paged KV
+    # with host swap, speculative decoding
+    "serving.decode.prefix.bytes": "gauge",
+    "serving.decode.prefix.evictions": "counter",
+    "serving.decode.prefix.full_hits": "counter",
+    "serving.decode.prefix.hit_rate": "gauge",
+    "serving.decode.prefix.hits": "counter",
+    "serving.decode.prefix.inserts": "counter",
+    "serving.decode.prefix.misses": "counter",
+    "serving.decode.paged.page_occupancy": "gauge",
+    "serving.decode.paged.pages_allocated": "counter",
+    "serving.decode.paged.swap_in_failures": "counter",
+    "serving.decode.paged.swapped_in": "counter",
+    "serving.decode.paged.swapped_out": "counter",
+    "serving.decode.spec.accept_rate": "gauge",
+    "serving.decode.spec.accepted": "counter",
+    "serving.decode.spec.iterations": "counter",
+    "serving.decode.spec.proposed": "counter",
     # live rollout / canary / rollback plane (serving/rollout.py,
     # DESIGN.md §18)
     "rollout.canary.agreement": "gauge",

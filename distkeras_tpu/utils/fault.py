@@ -169,6 +169,12 @@ def inject_chaos(site: str, action: str, after: int = 0,
       publisher ``delay_s``, ``torn`` delivers a half-serialized tree —
       engine swap validation must refuse it and keep serving the
       incumbent bit-for-bit (the swap-atomicity drill, DESIGN.md §18).
+    - ``"kv.swap_in"`` — the prefix-cache page restore
+      (``GenerationEngine._swap_in_entry``, serving/generation.py): ANY
+      armed action models a torn/lost host-to-device page restore. The
+      engine must evict the entry (a torn restore is never offered
+      twice) and degrade that request to a cold prefill — slower, never
+      a corrupted lane (DESIGN.md §19).
     """
     if action not in CHAOS_ACTIONS:
         raise ValueError(f"chaos action must be one of {CHAOS_ACTIONS}, "

@@ -20,7 +20,7 @@ PKG_MODULES = sorted(
 
 def test_discovery_found_the_tools():
     # the floor protects against the glob silently matching nothing
-    assert len(SCRIPTS) >= 16, SCRIPTS
+    assert len(SCRIPTS) >= 17, SCRIPTS
     assert "distkeras_tpu.benchmarks.run_config" in PKG_MODULES
     # the serving load generator (ISSUE 2) must be under the smoke glob
     assert any(os.path.basename(p) == "serving_load.py" for p in SCRIPTS)
@@ -48,6 +48,9 @@ def test_discovery_found_the_tools():
     # the live-rollout probe (ISSUE 13) too
     assert any(os.path.basename(p) == "rollout_probe.py"
                for p in SCRIPTS)
+    # the paged-KV memory probe (ISSUE 14) too
+    assert any(os.path.basename(p) == "paged_memory_probe.py"
+               for p in SCRIPTS)
 
 
 def test_step_probe_exposes_sweep_api():
@@ -66,6 +69,26 @@ def test_step_probe_exposes_sweep_api():
     assert callable(mod.overlap_probe)
     assert "precision" in inspect.signature(mod.sweep_probe).parameters
     assert "precision" in inspect.signature(mod.build_family).parameters
+
+
+def test_decode_bench_exposes_decode_leg_api():
+    """The decode accelerations (ISSUE 14) must stay addressable: the
+    prefix/longtail/speculative legs next to the original three modes,
+    and the paged memory probe's probe/sweep entry points."""
+    path = os.path.join(REPO, "benchmarks", "decode_bench.py")
+    spec = importlib.util.spec_from_file_location("decode_bench_legs", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for leg in ("run_naive", "run_static", "run_continuous",
+                "run_prefix", "run_longtail", "run_speculative"):
+        assert callable(getattr(mod, leg)), leg
+
+    path = os.path.join(REPO, "benchmarks", "paged_memory_probe.py")
+    spec = importlib.util.spec_from_file_location("paged_probe_api", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert callable(mod.probe) and callable(mod.sweep)
+    assert callable(mod.longtail_lengths)
 
 
 @pytest.mark.parametrize("path", SCRIPTS,
