@@ -51,6 +51,7 @@ _CONSUMER_PATHS = (
     "benchmarks/rollout_probe.py",
     "benchmarks/decode_bench.py",
     "benchmarks/paged_memory_probe.py",
+    "benchmarks/data_probe.py",
     "distkeras_tpu/health/export.py",
     "distkeras_tpu/health/endpoints.py",
     "distkeras_tpu/health/slo.py",

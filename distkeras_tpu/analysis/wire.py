@@ -77,6 +77,15 @@ PROTOCOLS: Tuple[Protocol, ...] = (
         client_only=("telemetry_merged", "coordinator"),
     ),
     Protocol(
+        name="data",
+        server_paths=("distkeras_tpu/data/service.py",),
+        client_paths=("distkeras_tpu/data/service.py",
+                      "distkeras_tpu/health/endpoints.py"),
+        # same HealthClient sharing as "serving": the fleet-merge and
+        # coordinator-discovery ops are mounted only on the PS services
+        client_only=("telemetry_merged", "coordinator"),
+    ),
+    Protocol(
         name="health",
         server_paths=("distkeras_tpu/health/endpoints.py",),
         client_paths=("distkeras_tpu/health/endpoints.py",),

@@ -4,8 +4,15 @@ from distkeras_tpu.data.dataset import (
     ShardedColumn,
     synthetic_mnist,
 )
-from distkeras_tpu.data.global_shards import GlobalShards
+from distkeras_tpu.data.global_shards import GlobalShards, ShardingError
 from distkeras_tpu.data.prefetch import prefetch
+from distkeras_tpu.data.service import (
+    DataCoordinator,
+    DataServiceClient,
+    DataServiceUnavailable,
+    stream_ranges,
+)
 
-__all__ = ["Dataset", "GlobalShards", "PermutedColumn", "ShardedColumn",
-           "prefetch", "synthetic_mnist"]
+__all__ = ["DataCoordinator", "DataServiceClient", "DataServiceUnavailable",
+           "Dataset", "GlobalShards", "PermutedColumn", "ShardedColumn",
+           "ShardingError", "prefetch", "stream_ranges", "synthetic_mnist"]

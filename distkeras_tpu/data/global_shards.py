@@ -30,6 +30,16 @@ from distkeras_tpu.data.dataset import Dataset, ShardedColumn
 from distkeras_tpu.utils import rng
 
 
+class ShardingError(ValueError):
+    """A shard pool that cannot satisfy the legacy equal-shard contract
+    (unequal row counts, mismatched shard counts, or a shard count that
+    does not divide the process count). Subclasses :class:`ValueError` so
+    pre-existing broad handlers keep working; the message always names the
+    offending counts. The streaming data service
+    (:mod:`distkeras_tpu.data.service`) has no such constraint — it is the
+    intended escape when this fires."""
+
+
 class GlobalShards:
     """An epoch-seeded assignment of shard files to hosts.
 
@@ -50,6 +60,16 @@ class GlobalShards:
     Dataset. The union over processes is the whole pool (a permutation), so
     the global per-epoch multiset of rows is preserved while each host's
     subset changes every epoch.
+
+    **Legacy equal-shard constraint (superseded).** This path requires
+    equal-sized shard files, a shard count divisible by the process count,
+    and a filesystem every host can see — Spark's assumptions from the
+    dist-keras lineage, enforced here as typed :class:`ShardingError`\\ s.
+    The streaming data service (:mod:`distkeras_tpu.data.service`,
+    DESIGN.md §20) supersedes all three: a :class:`~distkeras_tpu.data.
+    service.DataCoordinator` leases unequal row ranges to however many
+    workers are alive, and :meth:`streaming_dataset` is the bridge — the
+    whole pool as one lazy Dataset for the coordinator to serve.
     """
 
     def __init__(self, columns: Dict[str, Sequence[Union[str, bytes]]],
@@ -58,7 +78,7 @@ class GlobalShards:
             raise ValueError("GlobalShards needs at least one column")
         counts = {c: len(ps) for c, ps in columns.items()}
         if len(set(counts.values())) != 1:
-            raise ValueError(
+            raise ShardingError(
                 f"Every column needs the SAME shard count (shard i of each "
                 f"column holds the same rows); got {counts}")
         self.num_shards = next(iter(counts.values()))
@@ -76,10 +96,11 @@ class GlobalShards:
         sizes = {self._npy_rows(p)
                  for ps in self._paths.values() for p in ps}
         if len(sizes) != 1:
-            raise ValueError(
+            raise ShardingError(
                 f"All shard files must hold the SAME row count (hosts must "
                 f"stage equal rows under the static-shape contract); got "
-                f"sizes {sorted(sizes)}")
+                f"sizes {sorted(sizes)} — unequal shards stream fine "
+                f"through data.service.DataCoordinator")
         self.rows_per_shard = sizes.pop()
 
     @staticmethod
@@ -114,10 +135,13 @@ class GlobalShards:
         p = process_count if process_count is not None else \
             jax.process_count()
         if self.num_shards % p:
-            raise ValueError(
+            raise ShardingError(
                 f"{self.num_shards} shard files do not split evenly over "
-                f"{p} processes; provide a multiple (equal host row counts "
-                f"are the host-sharded contract)")
+                f"{p} processes (remainder {self.num_shards % p}); provide "
+                f"a multiple (equal host row counts are the host-sharded "
+                f"contract), or stream the pool through "
+                f"data.service.DataCoordinator, which has no divisibility "
+                f"constraint")
         perm = rng.permutation(self.seed * 1_000_003 + epoch,
                                self.num_shards)
         per = self.num_shards // p
@@ -138,4 +162,23 @@ class GlobalShards:
         for c, paths in self._paths.items():
             chosen = [np.load(paths[i], mmap_mode=mode) for i in idxs]
             out[c] = chosen[0] if len(chosen) == 1 else ShardedColumn(chosen)
+        return Dataset(out)
+
+    def streaming_dataset(self) -> Dataset:
+        """The WHOLE pool as one lazy Dataset — the bridge to the
+        streaming data service (DESIGN.md §20)::
+
+            coord = DataCoordinator(dataset=gs.streaming_dataset(), ...)
+
+        Every shard becomes part of a lazy :class:`ShardedColumn` (mmap —
+        no bytes read here); the coordinator reads only the row ranges
+        workers actually lease, so only IT needs to see the files. No
+        divisibility or equal-host-rows constraint applies: range
+        permutation replaces shard permutation, and epoch/cursor state
+        lives in the coordinator."""
+        mode = "r" if self._mmap else None
+        out = {}
+        for c, paths in self._paths.items():
+            parts = [np.load(p, mmap_mode=mode) for p in paths]
+            out[c] = parts[0] if len(parts) == 1 else ShardedColumn(parts)
         return Dataset(out)

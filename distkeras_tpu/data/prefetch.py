@@ -14,6 +14,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import traceback
 from typing import Iterable, Iterator, TypeVar
 
 from distkeras_tpu import telemetry
@@ -26,7 +27,9 @@ _DONE = object()
 def prefetch(it: Iterable[T], depth: int = 1) -> Iterator[T]:
     """Iterate ``it`` on a background thread, keeping up to ``depth`` items
     queued. Exceptions raised by the producer re-raise at the consumer's
-    ``next()``; ordering is preserved.
+    ``next()`` with the producer-side frames preserved as text on
+    ``exc.producer_traceback`` (and a ``data.prefetch.producer_errors``
+    count); ordering is preserved.
 
     Memory bound: at most ``depth + 1`` items exist beyond the one the
     consumer holds (``depth`` queued plus one the blocked producer has
@@ -76,7 +79,12 @@ def prefetch(it: Iterable[T], depth: int = 1) -> Iterator[T]:
                 if not _put((False, item)):
                     return
         except BaseException as e:  # propagate, don't swallow
-            _put((True, e))
+            # the exception re-raises on the CONSUMER thread, where its
+            # __traceback__ stops at this thread's boundary — carry the
+            # producer-side frames (the disk read / staging code that
+            # actually blew up) along as text
+            telemetry.counter("data.prefetch.producer_errors").inc()
+            _put((True, (e, traceback.format_exc())))
             return
         _put((False, _DONE))
 
@@ -90,7 +98,12 @@ def prefetch(it: Iterable[T], depth: int = 1) -> Iterator[T]:
             depth_hist.record(size)
             is_err, item = q.get()
             if is_err:
-                raise item
+                exc, tb_text = item
+                # attach the producer-side frames for handlers/logs; the
+                # chained note keeps `raise` semantics (type and args)
+                # identical to re-raising the original
+                exc.producer_traceback = tb_text
+                raise exc
             if item is _DONE:
                 return
             yield item

@@ -107,9 +107,35 @@ def _fleet_decode(rows: list) -> dict:
     return out
 
 
+def _fleet_data(rows: list) -> dict:
+    """Streaming-data-service digest for the fleet table (DESIGN.md §20):
+    shuffle-cursor position, epoch, leased/total ranges, and cumulative
+    re-leases. Keys appear only when a DataCoordinator exports the
+    metrics, so PS-only fleets pay no extra line."""
+    out = {}
+    wanted = {"data.service.cursor": ("cursor", int),
+              "data.service.epoch": ("epoch", int),
+              "data.service.leased_ranges": ("leased", int),
+              "data.service.ranges": ("ranges", int)}
+    releases = 0.0
+    have_releases = False
+    for r in rows:
+        picked = wanted.get(r.get("name"))
+        if picked and r.get("kind") == "gauge":
+            label, cast = picked
+            out[label] = cast(r.get("value", 0))
+        elif (r.get("name") == "data.service.releases"
+              and r.get("kind") == "counter"):
+            releases += float(r.get("value", 0))  # summed over reasons
+            have_releases = True
+    if out and have_releases:
+        out["releases"] = int(releases)
+    return out
+
+
 def _watch_table(workers: dict, prev: dict, interval: float,
                  fleet_alerts: list = (), fleet_versions: dict = (),
-                 fleet_decode: dict = ()) -> str:
+                 fleet_decode: dict = (), fleet_data: dict = ()) -> str:
     cols = ("worker", "hb_age", "windows", "win/s", "staleness",
             "degraded", "alerts", "flag")
     lines = [time.strftime("%H:%M:%S") + "  " +
@@ -137,6 +163,12 @@ def _watch_table(workers: dict, prev: dict, interval: float,
     if fleet_decode:
         lines.append("          DECODE: " + " ".join(
             f"{k}={v:.2f}" for k, v in sorted(fleet_decode.items())))
+    if fleet_data:
+        order = ("epoch", "cursor", "ranges", "leased", "releases")
+        parts = [f"{k}={fleet_data[k]}" for k in order if k in fleet_data]
+        parts += [f"{k}={v}" for k, v in sorted(fleet_data.items())
+                  if k not in order]
+        lines.append("          DATA: " + " ".join(parts))
     return "\n".join(lines)
 
 
@@ -265,7 +297,8 @@ def main(argv: Optional[list] = None) -> int:
                             args.interval if n else 0.0,
                             fleet_alerts=_fleet_alerts(rows),
                             fleet_versions=_fleet_versions(rows),
-                            fleet_decode=_fleet_decode(rows)),
+                            fleet_decode=_fleet_decode(rows),
+                            fleet_data=_fleet_data(rows)),
                             flush=True)
                         prev_windows = {w: d.get("windows", 0)
                                         for w, d in workers.items()}

@@ -232,12 +232,17 @@ class HostAsyncRunner:
     def run(self, init_params, epoch_shards: Sequence[Sequence[Sequence[dict]]],
             checkpointer=None, checkpoint_folds: int = 0,
             start_clock: int = 0, ps=None, worker_offset: int = 0,
-            fetch_final: bool = True, watchdog=None) -> tuple:
+            fetch_final: bool = True, watchdog=None,
+            snapshot_extra=None) -> tuple:
         """``epoch_shards[epoch][worker]`` is that worker's list of staged
         rounds for that epoch (per-epoch staging preserves the sync path's
         reshuffle-every-epoch semantics; pass the same object per epoch when
         not shuffling). Workers progress through epochs without barriers —
-        true asynchrony extends across epoch boundaries too.
+        true asynchrony extends across epoch boundaries too. A worker entry
+        may also be a ZERO-ARG CALLABLE returning its round iterable — the
+        streaming data service (data/service.py) passes lease-driven
+        generators this way, so rounds materialize lazily on the worker's
+        own prefetch thread instead of being staged up front.
 
         ``checkpointer``/``checkpoint_folds``: snapshot the live center +
         server clock every ``checkpoint_folds`` commits (the async-mode
@@ -261,7 +266,14 @@ class HostAsyncRunner:
         filtered) mean loss and a progress tick; a trip under an aborting
         policy stops every worker at its next round. The runner binds the
         watchdog's crash-time ``checkpoint_fn`` (live-center snapshot via
-        ``checkpointer``) and its ``on_trip`` abort hook when unset."""
+        ``checkpointer``) and its ``on_trip`` abort hook when unset.
+
+        ``snapshot_extra``: optional zero-arg callable returning a dict of
+        extra leaves merged into every checkpoint snapshot (periodic saver
+        AND crash-time). The streaming data plane passes
+        ``lambda: {"data_cursor": coordinator.cursor_carry()}`` so the
+        shuffle cursor rides the same save the center does (DESIGN.md
+        §20); keys must not collide with ``center``/``clock``."""
         num_workers = len(epoch_shards[0])
         if ps is None:
             # center (and its folds) live on device 0; workers pull across
@@ -303,9 +315,11 @@ class HostAsyncRunner:
                     center, clock = base_ps.pull()
                     if clock > last_saved:
                         t0 = time.perf_counter()
-                        checkpointer.save(
-                            clock, {"center": device_get_batched(center),
-                                    "clock": np.array([clock], np.int64)})
+                        snap = {"center": device_get_batched(center),
+                                "clock": np.array([clock], np.int64)}
+                        if snapshot_extra is not None:
+                            snap.update(snapshot_extra())
+                        checkpointer.save(clock, snap)
                         # the stall an in-commit-path save WOULD have cost
                         # a worker (pull + fetch + save dispatch) — the
                         # number that justifies the dedicated saver thread
@@ -336,7 +350,10 @@ class HostAsyncRunner:
                     # round ahead, so H2D staging overlaps the previous
                     # window's compute
                     for shards in epoch_shards:
-                        for batches in shards[k]:
+                        rounds = shards[k]
+                        if callable(rounds):  # lease-driven stream source
+                            rounds = rounds()
+                        for batches in rounds:
                             yield jax.device_put(batches, dev)
 
                 def bookkeep(clock_at_fold: int, pull_clock: int, ms,
@@ -413,9 +430,11 @@ class HostAsyncRunner:
                     # read the saver thread also relies on); wait() so the
                     # files exist before the trip aborts the process
                     center, clock = base_ps.pull()
-                    checkpointer.save(
-                        clock, {"center": device_get_batched(center),
-                                "clock": np.array([clock], np.int64)})
+                    snap = {"center": device_get_batched(center),
+                            "clock": np.array([clock], np.int64)}
+                    if snapshot_extra is not None:
+                        snap.update(snapshot_extra())
+                    checkpointer.save(clock, snap)
                     checkpointer.wait()
                 watchdog.checkpoint_fn = crash_checkpoint
             if watchdog.on_trip is None:
@@ -700,7 +719,8 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
                       history_timeout: float = 600.0,
                       watchdog=None, ps_shards: int = 1,
                       ps_placement: str = "process0",
-                      ps_standby: bool = False) -> tuple:
+                      ps_standby: bool = False,
+                      snapshot_extra=None) -> tuple:
     """Pod-scale TRUE-async: this process's worker threads against ONE live
     center owned by process 0 (VERDICT r4 ask #2 — the reference's
     workers-on-separate-machines semantics).
@@ -903,7 +923,8 @@ def run_cross_process(runner: HostAsyncRunner, init_params, epoch_shards,
                    checkpoint_folds=checkpoint_folds if pid == 0 else 0,
                    start_clock=start_clock, ps=local_ps,
                    worker_offset=worker_offset, fetch_final=False,
-                   watchdog=watchdog)
+                   watchdog=watchdog,
+                   snapshot_extra=snapshot_extra if pid == 0 else None)
         if pid == 0 and client is None:
             service.put_history(0, runner.merged_windows)
             merged, center, clock = service.get_history_blocking(
@@ -980,3 +1001,61 @@ def stage_worker_shards(shards, features_col: str, label_col: str,
             })
         out.append(rs)
     return out
+
+
+def stream_worker_rounds(address: str, worker: int, features_col: str,
+                         label_col: str, batch_size: int, window: int,
+                         token: Optional[str] = None, dataset=None,
+                         max_ranges: int = 2):
+    """A lease-driven round source for one worker: returns the ZERO-ARG
+    CALLABLE :meth:`HostAsyncRunner.run` accepts as an ``epoch_shards``
+    worker entry (streaming admission, DESIGN.md §20).
+
+    Each call opens a fresh :class:`~distkeras_tpu.data.service.
+    DataServiceClient` (the client is not thread-safe; one per worker
+    thread) and drives lease → materialize → ack against the coordinator
+    at ``address``, reshaping leased row ranges into the exact
+    ``[window, batch, ...]`` round dicts :func:`stage_worker_shards`
+    produces — the worker loop cannot tell staged and streamed rounds
+    apart. Rows come from ``dataset`` locally when given, else over the
+    wire. Epoch advancement is coordinator-side; the generator ends when
+    the coordinator reports the stream exhausted.
+
+    Accounting honesty: a range is acked once the consumer advances past
+    it, which can precede the emission of the round holding its final
+    rows — rows buffered toward an incomplete round when a worker dies
+    are bounded by ``batch_size * window + max_ranges * range_size``, the
+    same drop-remainder class of loss :func:`stage_worker_shards` has at
+    every shard tail."""
+    def rounds():
+        from distkeras_tpu.data.service import (DataServiceClient,
+                                                stream_ranges)
+        per_round = batch_size * window
+        client = DataServiceClient(address, worker=worker, token=token)
+        client.register()
+        cols = [features_col, label_col]
+        feats = labs = None  # row backlog pending reshape into rounds
+        try:
+            for _e, _pos, _start, _stop, rows in stream_ranges(
+                    client, dataset=dataset, cols=cols,
+                    max_ranges=max_ranges):
+                f, l = np.asarray(rows[features_col]), \
+                    np.asarray(rows[label_col])
+                feats = f if feats is None else np.concatenate([feats, f])
+                labs = l if labs is None else np.concatenate([labs, l])
+                while len(feats) >= per_round:
+                    tf, feats = feats[:per_round], feats[per_round:]
+                    tl, labs = labs[:per_round], labs[per_round:]
+                    yield {
+                        "features": tf.reshape((window, batch_size) +
+                                               tf.shape[1:]),
+                        "labels": tl.reshape((window, batch_size) +
+                                             tl.shape[1:]),
+                    }
+        finally:
+            try:
+                client.deregister()
+            except Exception:
+                pass
+            client.close()
+    return rounds

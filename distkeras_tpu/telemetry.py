@@ -84,10 +84,28 @@ METRIC_NAMES = {
     "comms.compress_ratio": "histogram",
     "comms.negotiated": "counter",
     # data plane
+    "data.prefetch.producer_errors": "counter",
     "data.prefetch.producer_wait_s": "histogram",
     "data.prefetch.puts": "counter",
     "data.prefetch.queue_depth": "gauge",
     "data.prefetch.queue_depth_samples": "histogram",
+    # streaming data service (data/service.py, DESIGN.md §20)
+    "data.service.acks": "counter",
+    "data.service.client.reconnects": "counter",
+    "data.service.client.retries": "counter",
+    "data.service.client.rtt_s": "histogram",
+    "data.service.client.unavailable": "counter",
+    "data.service.cursor": "gauge",
+    "data.service.dedup_hits": "counter",
+    "data.service.epoch": "gauge",
+    "data.service.fetch_rows": "counter",
+    "data.service.leased_ranges": "gauge",
+    "data.service.leases": "counter",
+    "data.service.ranges": "gauge",
+    "data.service.releases": "counter",
+    "data.service.server.auth_failures": "counter",
+    "data.service.server.dispatch": "counter",
+    "data.service.stale_acks": "counter",
     # elastic fleet membership (health/membership.py + remote_ps commits)
     "elastic.evictions": "counter",
     # coordinator failover plane (parallel/failover.py, DESIGN.md §17)

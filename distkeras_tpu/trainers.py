@@ -353,6 +353,7 @@ class DistributedTrainer(Trainer):
                  ps_placement: str = "process0",
                  ps_standby: bool = False,
                  weight_publisher=None,
+                 data_service=None,
                  **strategy_kwargs):
         super().__init__(model, loss, worker_optimizer, learning_rate,
                          metrics, features_col, label_col, batch_size,
@@ -428,6 +429,24 @@ class DistributedTrainer(Trainer):
         # True then shuffles within each host's rows (cross-host shuffling
         # would need a data exchange the reference also never did).
         self.data_layout = data_layout
+        # Streaming data plane (DESIGN.md §20): a DataCoordinator object
+        # (or "host:port" address of one) replaces up-front staging —
+        # worker threads lease permuted row ranges and ack them, so the
+        # global shuffle, epoch accounting, and churn recovery live on the
+        # coordinator. Orthogonal to (and exclusive with) the static
+        # data_layout contracts.
+        if data_service is not None:
+            if mode != "host_async":
+                raise ValueError(
+                    "data_service= streams lease-driven rounds to "
+                    "host_async worker threads; sync mode stages from a "
+                    "local Dataset — use mode='host_async'")
+            if data_layout != "replicated":
+                raise ValueError(
+                    "data_service replaces the data_layout contracts (the "
+                    "coordinator leases ranges to every worker wherever "
+                    "it runs); leave data_layout='replicated'")
+        self.data_service = data_service
         self.communication_window = int(communication_window)
         # None: stage the whole epoch device-resident (fastest for data that
         # fits). An int bounds staging memory to O(staging_rounds) with
@@ -908,7 +927,22 @@ class DistributedTrainer(Trainer):
             local_workers = counts[pid]
         else:
             worker_offset, local_workers = 0, self.num_workers
-        if self.data_layout == "host_sharded" and multi:
+        stage = None
+        if self.data_service is not None:
+            # Streaming data plane (DESIGN.md §20): no up-front staging —
+            # each worker thread gets a lease-driven round generator
+            # against the coordinator. Epochs and the global shuffle are
+            # COORDINATOR state (its seed / num_epochs), so trainer-side
+            # shuffle= and num_epoch do not apply here.
+            if shuffle:
+                raise ValueError(
+                    "shuffle=True with data_service=: the coordinator "
+                    "already owns the global shuffle (its seed= argument); "
+                    "a second trainer-side shuffle would be dead code")
+            svc = self.data_service
+            svc_address = svc if isinstance(svc, str) else svc.address
+            svc_token = None if isinstance(svc, str) else svc.token
+        elif self.data_layout == "host_sharded" and multi:
             # local dataset = ONLY this process's workers' rows. Data
             # sufficiency is per-process state, so validate it with a tiny
             # allgather and raise on EVERY process (same hazard as the
@@ -950,6 +984,18 @@ class DistributedTrainer(Trainer):
         with span("trainer.init"):
             state = self._init_params(dataset)
         init_params, start_clock = state.params, 0
+        # streaming data plane: when the trainer HOLDS the coordinator
+        # object (not just its address), the shuffle cursor rides every
+        # snapshot and restores on resume — the torn-coordinator recovery
+        # path (DESIGN.md §20). Address-only callers checkpoint the cursor
+        # themselves via DataServiceClient.cursor().
+        coord_obj = self.data_service \
+            if (self.data_service is not None
+                and not isinstance(self.data_service, str)) else None
+        snapshot_extra = None
+        if coord_obj is not None:
+            def snapshot_extra():
+                return {"data_cursor": coord_obj.cursor_carry()}
         # process 0 alone owns the live center's snapshots; Orbax must not
         # expect its peers at any barrier (local_host_only)
         ckpt, ckpt_error = None, None
@@ -957,16 +1003,19 @@ class DistributedTrainer(Trainer):
             try:
                 ckpt = self._checkpointer(local_host_only=multi)
                 if ckpt is not None:
+                    like = {"center": init_params,
+                            "clock": np.zeros((1,), np.int64)}
+                    if coord_obj is not None:
+                        like["data_cursor"] = coord_obj.cursor_carry()
                     try:
-                        snap, _ = self._maybe_resume(
-                            ckpt, {"center": init_params,
-                                   "clock": np.zeros((1,), np.int64)},
-                            resume)
+                        snap, _ = self._maybe_resume(ckpt, like, resume)
                     except BaseException:
                         ckpt.close()
                         raise
                     init_params = snap["center"]
                     start_clock = int(np.asarray(snap["clock"])[0])
+                    if coord_obj is not None and resume:
+                        coord_obj.restore_cursor(snap["data_cursor"])
             except BaseException as e:
                 if not multi:
                     raise
@@ -992,7 +1041,18 @@ class DistributedTrainer(Trainer):
                 else dataset
             return ds.shuffle(self.seed + e) if shuffle else ds
 
-        if shuffle or provider is not None:
+        if self.data_service is not None:
+            # one epoch_shards entry; the coordinator streams ALL its
+            # epochs through it (workers lease until it reports the
+            # stream exhausted), so there is no per-epoch staging and no
+            # host-resident copy at all
+            with span("trainer.stage"):
+                epoch_shards = [[host_async.stream_worker_rounds(
+                    svc_address, worker_offset + k, self.features_col,
+                    self.label_col, self.batch_size,
+                    self.communication_window, token=svc_token)
+                    for k in range(local_workers)]]
+        elif shuffle or provider is not None:
             # Per-epoch reshuffle and/or cross-host shard re-deal. Workers
             # cross epoch boundaries without barriers, so every epoch's
             # shards are staged host-resident UP FRONT — num_epoch x the
@@ -1043,12 +1103,13 @@ class DistributedTrainer(Trainer):
                             checkpoint_folds=folds, start_clock=start_clock,
                             watchdog=watchdog, ps_shards=self.ps_shards,
                             ps_placement=self.ps_placement,
-                            ps_standby=self.ps_standby)
+                            ps_standby=self.ps_standby,
+                            snapshot_extra=snapshot_extra)
                 else:
                     params, history, staleness, num_updates = runner.run(
                         init_params, epoch_shards, checkpointer=ckpt,
                         checkpoint_folds=folds, start_clock=start_clock,
-                        watchdog=watchdog)
+                        watchdog=watchdog, snapshot_extra=snapshot_extra)
         except BaseException:
             # postmortem bundle FIRST (ring + status + fingerprint, next to
             # the crash checkpoint), then finalize in-flight snapshots

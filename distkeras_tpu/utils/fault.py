@@ -175,6 +175,18 @@ def inject_chaos(site: str, action: str, after: int = 0,
       engine must evict the entry (a torn restore is never offered
       twice) and degrade that request to a cold prefill — slower, never
       a corrupted lane (DESIGN.md §19).
+    - ``"data.lease"`` — data-coordinator dispatch
+      (:meth:`DataCoordinator._dispatch`, data/service.py): ``delay``
+      stalls the coordinator, ``reset`` drops the connection instead of
+      replying (the client retries; ``(cid, seq)`` dedup absorbs an
+      applied-but-unreplied lease/ack), ``kill`` takes the coordinator
+      down — the torn-restart drill that must resume the shuffle cursor
+      bitwise-deterministically (DESIGN.md §20).
+    - ``"data.fetch"`` — data-client request egress
+      (:meth:`DataServiceClient._send_once`): same action semantics as
+      ``remote_ps.send`` (``reset`` before the bytes leave,
+      ``reset_after_send`` after — the ack-dedup scenario, ``drop``
+      swallows the request into a timeout, ``delay`` sleeps first).
     """
     if action not in CHAOS_ACTIONS:
         raise ValueError(f"chaos action must be one of {CHAOS_ACTIONS}, "

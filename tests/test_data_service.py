@@ -1,0 +1,498 @@
+"""Streaming data service (DESIGN.md §20): leased ranges, resumable global
+shuffle, exactly-once epoch accounting — including the PR's chaos
+acceptance drills (worker killed mid-epoch, torn coordinator restart)."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import comms, telemetry
+from distkeras_tpu.data.dataset import Dataset, synthetic_mnist
+from distkeras_tpu.data.global_shards import GlobalShards, ShardingError
+from distkeras_tpu.data.prefetch import prefetch
+from distkeras_tpu.data.service import (DataCoordinator, DataServiceClient,
+                                        DataServiceUnavailable,
+                                        stream_ranges)
+from distkeras_tpu.utils import fault
+
+FAST_RETRY = comms.RetryPolicy(max_retries=2, base_s=0.01, max_s=0.02)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    fault.clear_chaos()
+    yield
+    fault.clear_chaos()
+
+
+def _dataset(n=100):
+    return Dataset({
+        "features": np.arange(2 * n, dtype=np.float32).reshape(n, 2),
+        "label": np.arange(n, dtype=np.int64)})
+
+
+def _drain(coord, worker=0, max_ranges=1, dataset=None):
+    """One worker drains the whole stream; returns the consumed
+    (epoch, pos, start, stop) tuples in consumption order."""
+    out = []
+    with DataServiceClient(coord.address, worker=worker,
+                          retry=FAST_RETRY) as c:
+        for e, pos, start, stop, rows in stream_ranges(
+                c, dataset=dataset, max_ranges=max_ranges):
+            out.append((e, pos, start, stop))
+    return out
+
+
+# -- deterministic shuffle & exactly-once accounting -----------------------
+
+def test_unequal_last_range_and_full_coverage():
+    coord = DataCoordinator(total_rows=103, range_size=10, seed=7)
+    assert coord.num_ranges == 11
+    stream = coord.epoch_stream(0)
+    # every row exactly once; exactly one (the last) range is short
+    rows = sorted((s, t) for _, s, t in stream)
+    assert rows[0][0] == 0 and rows[-1][1] == 103
+    sizes = sorted(t - s for _, s, t in stream)
+    assert sizes == [3] + [10] * 10
+    covered = np.zeros(103, bool)
+    for _, s, t in stream:
+        assert not covered[s:t].any()  # no overlap
+        covered[s:t] = True
+    assert covered.all()
+    coord.stop()
+
+
+def test_epoch_stream_seeded_and_epoch_varied():
+    a = DataCoordinator(total_rows=96, range_size=8, seed=3)
+    b = DataCoordinator(total_rows=96, range_size=8, seed=3)
+    assert a.epoch_stream(0) == b.epoch_stream(0)
+    assert a.epoch_stream(0) != a.epoch_stream(1)  # reshuffle per epoch
+    c = DataCoordinator(total_rows=96, range_size=8, seed=4)
+    assert a.epoch_stream(0) != c.epoch_stream(0)
+    for x in (a, b, c):
+        x.stop()
+
+
+def test_single_worker_drains_exactly_once_in_stream_order():
+    ds = _dataset(90)
+    coord = DataCoordinator(dataset=ds, range_size=16, seed=5)
+    coord.start()
+    seen = _drain(coord, max_ranges=2)
+    assert sorted(p for _, p, _, _ in seen) == list(range(coord.num_ranges))
+    # the (epoch, pos) sort key recovers the canonical global order
+    assert [(p, s, t) for _, p, s, t in sorted(seen)] \
+        == coord.epoch_stream(0)
+    assert list(coord.cursor_carry()) == [1, coord.num_ranges]  # exhausted
+    coord.stop()
+
+
+def test_worker_count_does_not_reorder_global_stream():
+    """1 → N → M workers: the recovered global stream is bitwise-identical
+    (resharding must not reorder — ISSUE 15 satellite)."""
+    ds = _dataset(120)
+    orders = []
+    for workers in (1, 3, 2):
+        coord = DataCoordinator(dataset=ds, range_size=16, seed=11)
+        coord.start()
+        lock = threading.Lock()
+        seen = []
+
+        def run(w):
+            with DataServiceClient(coord.address, worker=w,
+                                  retry=FAST_RETRY) as c:
+                for item in stream_ranges(c):
+                    with lock:
+                        seen.append(item[:4])
+
+        threads = [threading.Thread(target=run, args=(w,))
+                   for w in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # exactly-once across however many workers
+        assert sorted(p for _, p, _, _ in seen) \
+            == list(range(coord.num_ranges))
+        orders.append([(e, p, s, t) for e, p, s, t in sorted(seen)])
+        coord.stop()
+    assert orders[0] == orders[1] == orders[2]
+
+
+def test_multi_epoch_streaming():
+    ds = _dataset(48)
+    coord = DataCoordinator(dataset=ds, range_size=16, seed=2,
+                            num_epochs=3)
+    coord.start()
+    seen = _drain(coord)
+    assert sorted(e for e, _, _, _ in seen) == [0] * 3 + [1] * 3 + [2] * 3
+    by_epoch = {e: [(p, s, t) for ee, p, s, t in sorted(seen) if ee == e]
+                for e in range(3)}
+    for e in range(3):
+        assert by_epoch[e] == coord.epoch_stream(e)
+    assert by_epoch[0] != by_epoch[1]  # reshuffled between epochs
+    coord.stop()
+
+
+# -- fetch plane -----------------------------------------------------------
+
+def test_wire_fetch_roundtrips_exact_rows():
+    ds = _dataset(40)
+    coord = DataCoordinator(dataset=ds, range_size=8, seed=0)
+    coord.start()
+    c = DataServiceClient(coord.address, worker=0, retry=FAST_RETRY)
+    c.register()
+    assert c.meta["serves_data"] is True
+    rows = c.fetch(5, 19)
+    np.testing.assert_array_equal(rows["features"],
+                                  np.asarray(ds["features"][5:19]))
+    np.testing.assert_array_equal(rows["label"],
+                                  np.asarray(ds["label"][5:19]))
+    assert rows["features"].dtype == np.float32
+    with pytest.raises(RuntimeError, match="bad_range|outside"):
+        c.fetch(30, 50)
+    c.close()
+    coord.stop()
+
+
+def test_order_only_coordinator_requires_local_rows():
+    coord = DataCoordinator(total_rows=32, range_size=8)
+    coord.start()
+    c = DataServiceClient(coord.address, worker=0, retry=FAST_RETRY)
+    c.register()
+    assert c.meta["serves_data"] is False
+    with pytest.raises(ValueError, match="one side must hold the rows"):
+        next(stream_ranges(c))
+    # local-slice mode works against the same coordinator
+    seen = list(stream_ranges(c, dataset=_dataset(32)))
+    assert len(seen) == 4
+    c.close()
+    coord.stop()
+
+
+def test_token_auth_rejects_bad_client():
+    coord = DataCoordinator(total_rows=16, range_size=8, token="secret")
+    coord.start()
+    bad = DataServiceClient(coord.address, worker=0, token="wrong",
+                            retry=FAST_RETRY)
+    with pytest.raises(RuntimeError, match="authentication"):
+        bad.register()
+    bad.close()
+    good = DataServiceClient(coord.address, worker=0, token="secret",
+                             retry=FAST_RETRY)
+    assert good.register()["num_ranges"] == 2
+    good.close()
+    coord.stop()
+
+
+# -- chaos acceptance ------------------------------------------------------
+
+def test_worker_killed_mid_epoch_zero_lost_zero_duplicated():
+    """THE acceptance drill: worker A leases ranges, lands + acks one,
+    dies holding two unacked. After its lease lapses the survivor inherits
+    them and the epoch completes — per-range id accounting shows every
+    range landed exactly once."""
+    ds = _dataset(80)
+    coord = DataCoordinator(dataset=ds, range_size=8, seed=9,
+                            lease_s=0.15)
+    coord.start()
+    landed = []  # (who, pos) for every range whose batches landed
+
+    a = DataServiceClient(coord.address, worker=0, retry=FAST_RETRY)
+    a.register()
+    grant = a.lease(max_ranges=3)
+    assert len(grant["ranges"]) == 3
+    # A lands ONE range's batches and acks it...
+    pos0, s0, t0 = grant["ranges"][0]
+    a.fetch(s0, t0)
+    landed.append(("A", pos0))
+    assert a.ack(grant["epoch"], [pos0])["retired"] == 1
+    # ...then dies (no deregister — exactly what a killed process looks
+    # like). Its two remaining leases are unacked.
+    a.close()
+
+    time.sleep(0.25)  # > lease_s: A's lease lapses
+
+    with DataServiceClient(coord.address, worker=1,
+                          retry=FAST_RETRY) as b:
+        for e, pos, s, t, rows in stream_ranges(b, max_ranges=2):
+            landed.append(("B", pos))
+    # zero lost, zero duplicated: every range landed exactly once
+    assert sorted(p for _, p in landed) == list(range(coord.num_ranges))
+    # and the two abandoned ranges really were re-leased to the survivor
+    abandoned = {p for p, _, _ in grant["ranges"][1:]}
+    assert {p for who, p in landed if who == "B"} >= abandoned
+    assert list(coord.cursor_carry()) == [1, coord.num_ranges]
+    coord.stop()
+
+
+def test_coordinator_kill_restart_resumes_cursor_bitwise():
+    """Torn-coordinator drill: chaos-kill the coordinator mid-epoch, bring
+    up a FRESH one from the checkpointed cursor, and require the full
+    consumed stream to be bitwise-identical to an uninterrupted run."""
+    ds = _dataset(112)
+
+    def mk():
+        return DataCoordinator(dataset=ds, range_size=16, seed=13)
+
+    ref_coord = mk()
+    reference = ref_coord.epoch_stream(0)
+    ref_coord.stop()
+
+    coord = mk()
+    coord.start()
+    consumed, carry = [], coord.cursor_carry()
+    # the 8th dispatch dies mid-serve (register + 3x(lease,ack) are clean)
+    fault.inject_chaos("data.lease", "kill", after=7)
+    with pytest.raises(DataServiceUnavailable):
+        c = DataServiceClient(coord.address, worker=0, retry=FAST_RETRY)
+        c.register()
+        for item in stream_ranges(c):
+            consumed.append(item[:4])
+            carry = coord.cursor_carry()  # the trainer's snapshot_extra
+    fault.clear_chaos()
+    assert 0 < len(consumed) < coord.num_ranges  # genuinely torn mid-epoch
+    assert not coord._running  # the kill took the service down
+
+    fresh = mk()  # new process: fresh port, fresh ledger
+    fresh.restore_cursor(carry)
+    fresh.start()
+    resumed = _drain(fresh)
+    # bitwise-deterministic resume: the suffix is exactly the reference
+    # stream from the checkpointed watermark, and checkpoint-prefix +
+    # suffix IS the reference. Ranges consumed after the snapshot but
+    # before the crash replay deterministically — the same replay
+    # semantics a post-checkpoint training step has.
+    w = int(carry[1])
+    assert [(p, s, t) for _, p, s, t in resumed] == reference[w:]
+    assert [(p, s, t) for _, p, s, t in consumed[:w]] \
+        + [(p, s, t) for _, p, s, t in resumed] == reference
+    assert list(fresh.cursor_carry()) == [1, fresh.num_ranges]
+    fresh.stop()
+
+
+def test_ack_applied_but_reply_lost_dedups_on_retry():
+    """reset_after_send on the ack: the server retires the range and the
+    reply dies with the connection. The retried (cid, seq) must replay the
+    cached reply, not double-retire."""
+    coord = DataCoordinator(total_rows=32, range_size=8, seed=1)
+    coord.start()
+    c = DataServiceClient(coord.address, worker=0, retry=FAST_RETRY)
+    c.register()
+    grant = c.lease()
+    pos = grant["ranges"][0][0]
+    # egress chaos: the ack is this client's 3rd framed request
+    fault.inject_chaos("data.fetch", "reset_after_send", after=0)
+    reply = c.ack(grant["epoch"], [pos])
+    fault.clear_chaos()
+    # the retry replayed the APPLIED result: retired once, not stale
+    assert reply == {"retired": 1, "stale": 0, "epoch_done": False,
+                     "epoch": 0, "blob_lens": []}
+    assert int(coord.cursor_carry()[1]) == 1
+    c.close()
+    coord.stop()
+
+
+def test_lease_request_survives_connection_reset():
+    coord = DataCoordinator(total_rows=32, range_size=8)
+    coord.start()
+    c = DataServiceClient(coord.address, worker=0, retry=FAST_RETRY)
+    c.register()
+    fault.inject_chaos("data.fetch", "reset", after=0)  # lost before send
+    grant = c.lease()
+    assert len(grant["ranges"]) == 1  # retried transparently, granted once
+    c.close()
+    coord.stop()
+
+
+def test_client_raises_typed_unavailable_when_coordinator_gone():
+    coord = DataCoordinator(total_rows=16, range_size=8)
+    coord.start()
+    c = DataServiceClient(coord.address, worker=0, retry=FAST_RETRY)
+    c.register()
+    coord.kill()
+    with pytest.raises(DataServiceUnavailable):
+        c.lease()
+    c.close()
+
+
+# -- cursor carry edge cases ----------------------------------------------
+
+def test_cursor_carry_validation_and_exhausted_restore():
+    coord = DataCoordinator(total_rows=16, range_size=8, num_epochs=2)
+    with pytest.raises(ValueError, match="epoch, watermark"):
+        coord.restore_cursor(np.zeros(3, np.int64))
+    with pytest.raises(ValueError, match="outside"):
+        coord.restore_cursor(np.array([0, 99], np.int64))
+    coord.restore_cursor(np.array([2, 2], np.int64))  # past num_epochs
+    coord.start()
+    assert _drain(coord, dataset=_dataset(16)) == []  # nothing left
+    coord.stop()
+
+
+def test_restore_mid_epoch_serves_exact_suffix():
+    coord = DataCoordinator(total_rows=64, range_size=8, seed=21,
+                            num_epochs=1)
+    coord.restore_cursor(np.array([0, 5], np.int64))
+    coord.start()
+    seen = _drain(coord, dataset=_dataset(64))
+    assert [(p, s, t) for _, p, s, t in seen] == coord.epoch_stream(0)[5:]
+    coord.stop()
+
+
+# -- satellites ------------------------------------------------------------
+
+def test_global_shards_typed_sharding_error(tmp_path):
+    paths = []
+    for i in range(3):
+        p = tmp_path / f"s{i}.npy"
+        np.save(p, np.zeros((4, 2), np.float32))
+        paths.append(str(p))
+    gs = GlobalShards({"features": paths})
+    with pytest.raises(ShardingError) as e:
+        gs.epoch_assignment(0, process_count=2)
+    assert isinstance(e.value, ValueError)  # broad handlers keep working
+    assert "3 shard files" in str(e.value) and "2 processes" in str(e.value)
+    assert "DataCoordinator" in str(e.value)  # names the escape hatch
+    # unequal shard files: typed at construction too
+    bad = tmp_path / "s3.npy"
+    np.save(bad, np.zeros((5, 2), np.float32))
+    with pytest.raises(ShardingError, match="SAME row count"):
+        GlobalShards({"features": paths + [str(bad)]})
+
+
+def test_global_shards_streaming_dataset_bridges_to_service(tmp_path):
+    rows = np.arange(24, dtype=np.float32).reshape(12, 2)
+    paths = []
+    for i in range(3):
+        p = tmp_path / f"f{i}.npy"
+        np.save(p, rows[i * 4:(i + 1) * 4])
+        paths.append(str(p))
+    gs = GlobalShards({"features": paths})
+    ds = gs.streaming_dataset()
+    assert len(ds) == 12
+    coord = DataCoordinator(dataset=ds, range_size=5)  # indivisible: fine
+    coord.start()
+    seen = _drain(coord)
+    got = np.concatenate([
+        np.asarray(ds["features"][s:t])
+        for _, _, s, t in sorted(seen)])
+    np.testing.assert_array_equal(np.sort(got.ravel()),
+                                  np.sort(rows.ravel()))
+    coord.stop()
+
+
+def test_prefetch_reraises_with_producer_traceback():
+    def producer():
+        yield 1
+        raise RuntimeError("disk on fire")
+
+    it = prefetch(producer(), depth=1)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="disk on fire") as e:
+        list(it)
+    tb = e.value.producer_traceback
+    assert "producer" in tb and "disk on fire" in tb  # the producer frames
+
+
+def test_fleet_data_line_in_watch_table():
+    from distkeras_tpu.health.cli import _fleet_data, _watch_table
+
+    rows = [
+        {"kind": "gauge", "name": "data.service.cursor", "value": 7.0},
+        {"kind": "gauge", "name": "data.service.epoch", "value": 1.0},
+        {"kind": "gauge", "name": "data.service.leased_ranges",
+         "value": 3.0},
+        {"kind": "gauge", "name": "data.service.ranges", "value": 20.0},
+        {"kind": "counter", "name": "data.service.releases",
+         "labels": {"reason": "lease"}, "value": 2.0},
+        {"kind": "counter", "name": "data.service.releases",
+         "labels": {"reason": "deregister"}, "value": 1.0},
+    ]
+    digest = _fleet_data(rows)
+    assert digest == {"cursor": 7, "epoch": 1, "leased": 3, "ranges": 20,
+                      "releases": 3}
+    table = _watch_table({}, {}, 0.0, fleet_data=digest)
+    line = [ln for ln in table.splitlines() if "DATA:" in ln]
+    assert line and "cursor=7" in line[0] and "releases=3" in line[0]
+    # PS-only fleets (no data gauges) pay no line
+    assert _fleet_data([{"kind": "gauge", "name": "x.y", "value": 1}]) == {}
+    assert "DATA:" not in _watch_table({}, {}, 0.0)
+
+
+def test_status_digest_on_health_plane():
+    from distkeras_tpu.health.endpoints import HealthClient
+
+    coord = DataCoordinator(total_rows=40, range_size=8)
+    coord.start()
+    c = DataServiceClient(coord.address, worker=0, retry=FAST_RETRY)
+    c.register()
+    c.lease(max_ranges=2)
+    hc = HealthClient(coord.address)
+    status = hc.status()
+    assert status["data"]["ranges"] == 5
+    assert status["data"]["leased"] == 2
+    assert status["data"]["cursor"] == 0
+    hc.close()
+    c.close()
+    coord.stop()
+
+
+# -- trainer integration ---------------------------------------------------
+
+def test_stream_worker_rounds_matches_staged_shapes():
+    from distkeras_tpu.parallel import host_async
+
+    ds = synthetic_mnist(n=128)
+    coord = DataCoordinator(dataset=ds, range_size=32, seed=4)
+    coord.start()
+    src = host_async.stream_worker_rounds(
+        coord.address, worker=0, features_col="features",
+        label_col="label", batch_size=8, window=2)
+    rounds = list(src())
+    assert len(rounds) == 128 // 16
+    for r in rounds:
+        assert r["features"].shape == (2, 8, 784)
+        assert r["labels"].shape == (2, 8, 10)
+    coord.stop()
+
+
+def test_adag_host_async_trains_from_data_service(tmp_path):
+    from distkeras_tpu import ADAG
+    from distkeras_tpu.models.mlp import MLP
+
+    ds = synthetic_mnist(n=256)
+    coord = DataCoordinator(dataset=ds, range_size=64, seed=0,
+                            num_epochs=2)
+    coord.start()
+    t = ADAG(MLP(features=(16,), num_classes=10), learning_rate=0.05,
+             batch_size=16, num_workers=2, communication_window=2,
+             mode="host_async", data_service=coord,
+             checkpoint_dir=str(tmp_path / "ck"), checkpoint_folds=2)
+    t.train(ds)
+    # 2 epochs x 256 rows / 16-row batches = 32 minibatch steps landed
+    assert len(t.history) == 32
+    assert list(coord.cursor_carry()) == [2, coord.num_ranges]
+    coord.stop()
+    # the shuffle cursor rode the Orbax snapshot next to the center
+    ck = t._checkpointer()
+    snap = ck.restore(like={"center": t.params,
+                            "clock": np.zeros((1,), np.int64),
+                            "data_cursor": np.zeros((2,), np.int64)})
+    assert list(np.asarray(snap["data_cursor"])) == [2, coord.num_ranges]
+    ck.close()
+
+
+def test_data_service_kwarg_validation():
+    from distkeras_tpu import ADAG
+    from distkeras_tpu.models.mlp import MLP
+
+    with pytest.raises(ValueError, match="host_async"):
+        ADAG(MLP(features=(8,), num_classes=10), num_workers=2,
+             data_service="127.0.0.1:1")
+    with pytest.raises(ValueError, match="data_layout"):
+        ADAG(MLP(features=(8,), num_classes=10), num_workers=2,
+             mode="host_async", data_layout="host_sharded",
+             data_service="127.0.0.1:1")

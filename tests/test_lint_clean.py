@@ -108,11 +108,12 @@ def test_layering_carries_the_health_no_jax_rule():
         assert any(fnmatch.fnmatch(rel, pat) for pat in covered), rel
 
 
-def test_wire_config_names_all_three_servers():
+def test_wire_config_names_all_four_servers():
     servers = {p for proto in PROTOCOLS for p in proto.server_paths}
     assert servers == {"distkeras_tpu/parallel/remote_ps.py",
                        "distkeras_tpu/serving/server.py",
-                       "distkeras_tpu/health/endpoints.py"}
+                       "distkeras_tpu/health/endpoints.py",
+                       "distkeras_tpu/data/service.py"}
 
 
 def test_committed_baseline_is_empty():
