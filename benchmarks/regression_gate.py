@@ -30,6 +30,17 @@ phases (``--check phases --phases-baseline a.jsonl --phases-fresh b.jsonl``)
     window grew by more than ``--phase-budget`` (absolute frac) — "the
     regression is real AND it lives in commit, not compute".
 
+roofline (``--check roofline``)
+    Learns the op-level ladder from the committed
+    ``results/pr*_attribution_ops.jsonl`` files (attribution.py --ops
+    rows) and judges the newest one against absolute floors (op coverage
+    >= 0.90 of the executable's modeled FLOPs; default-path overhead <=
+    2%) and against the prior file: any op whose share of modeled step
+    time GREW by more than ``--op-budget`` (absolute) fails — so a
+    future kernel PR must show its target op shrinking, not just the
+    wall clock moving. Ops present in only one file don't vote (XLA is
+    free to rename fusions between releases).
+
 decode (``--check decode``)
     Learns the serving-decode ladder from the committed
     ``results/pr*_decode_bench.jsonl`` files (decode_bench.py rows) and
@@ -51,6 +62,7 @@ the process exits 0 iff every verdict passed, so CI can gate on it::
         --phases-baseline results/pr10_attribution.jsonl \
         --phases-fresh fresh_attribution.jsonl
     python benchmarks/regression_gate.py --check decode
+    python benchmarks/regression_gate.py --check roofline
 """
 
 from __future__ import annotations
@@ -174,6 +186,116 @@ def load_decode_history(repo_dir: str = REPO) -> List[Tuple[int, dict]]:
             out.append((int(m.group(1)), metrics))
     out.sort(key=lambda t: t[0])
     return out
+
+
+#: absolute floors for the op-level ladder (ISSUE 16 acceptance):
+#: coverage of the executable's modeled FLOPs, and the default-path
+#: overhead of the per-window MFU publication.
+ROOFLINE_COVERAGE_FLOOR = 0.90
+ROOFLINE_OVERHEAD_CEIL = 0.02
+DEFAULT_OP_BUDGET = 0.05
+
+
+def load_roofline_history(repo_dir: str = REPO) -> List[Tuple[int, dict]]:
+    """``[(pr_n, doc), ...]`` sorted by PR from the committed
+    ``results/pr*_attribution_ops.jsonl`` files. ``doc`` carries
+    ``coverage``/``overhead_frac`` plus ``shares`` ({op: share}) and
+    ``bounds`` ({op: boundedness}) from the top-k op rows."""
+    out = []
+    pattern = os.path.join(repo_dir, "benchmarks", "results",
+                           "pr*_attribution_ops.jsonl")
+    for path in sorted(glob.glob(pattern)):
+        m = re.search(r"pr(\d+)_attribution_ops\.jsonl$", path)
+        if m is None:
+            continue
+        doc: dict = {"shares": {}, "bounds": {}}
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    row = json.loads(line)
+                    if row.get("kind") == "roofline":
+                        doc["coverage"] = row.get("coverage")
+                    elif row.get("kind") == "overhead":
+                        doc["overhead_frac"] = row.get("overhead_frac")
+                    elif row.get("kind") == "op":
+                        doc["shares"][row["op"]] = row.get("share", 0.0)
+                        doc["bounds"][row["op"]] = row.get("bound", "?")
+        except (OSError, ValueError):
+            continue
+        if doc["shares"] or "coverage" in doc:
+            out.append((int(m.group(1)), doc))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def judge_roofline(history: List[Tuple[int, dict]],
+                   coverage_floor: float = ROOFLINE_COVERAGE_FLOOR,
+                   overhead_ceil: float = ROOFLINE_OVERHEAD_CEIL,
+                   op_budget: float = DEFAULT_OP_BUDGET) -> List[dict]:
+    """Op-ladder gate: newest evidence vs the absolute floors, and each
+    shared top-op's time share vs the prior release."""
+    if not history:
+        return [{"kind": "verdict", "check": "roofline", "metric": "*",
+                 "status": "fail",
+                 "note": "no pr*_attribution_ops.jsonl evidence "
+                         "committed (run attribution.py --ops --run)"}]
+    n_new, newest = history[-1]
+    verdicts = []
+    cov = newest.get("coverage")
+    if cov is not None:
+        status = "pass" if cov >= coverage_floor else "fail"
+        verdicts.append({
+            "kind": "verdict", "check": "roofline",
+            "metric": "profile.op.coverage", "release": n_new,
+            "observed": cov, "floor": coverage_floor, "status": status,
+            "note": (f"pr{n_new:02d} op rows cover {cov:.1%} of the "
+                     f"executable's modeled FLOPs (floor "
+                     f"{coverage_floor:.0%})")})
+    over = newest.get("overhead_frac")
+    if over is not None:
+        status = "pass" if over <= overhead_ceil else "fail"
+        verdicts.append({
+            "kind": "verdict", "check": "roofline",
+            "metric": "profile.op.default_path_overhead",
+            "release": n_new, "observed": over, "ceiling": overhead_ceil,
+            "status": status,
+            "note": (f"pr{n_new:02d} default-path overhead "
+                     f"{over:+.2%} (ceiling {overhead_ceil:.0%}, "
+                     f"capture stays opt-in)")})
+    if len(history) >= 2:
+        n_base, base = history[-2]
+        shared = sorted(set(base["shares"]) & set(newest["shares"]))
+        for op in shared:
+            sb, sn = base["shares"][op], newest["shares"][op]
+            shift = sn - sb
+            status = "pass" if shift <= op_budget else "fail"
+            verdicts.append({
+                "kind": "verdict", "check": "roofline",
+                "metric": f"profile.op.share{{op={op}}}",
+                "baseline_release": n_base, "release": n_new,
+                "baseline": sb, "observed": sn,
+                "delta_frac": round(shift, 6), "budget_frac": op_budget,
+                "bound": newest["bounds"].get(op, "?"),
+                "status": status,
+                "note": (f"pr{n_base:02d}->pr{n_new:02d} {op} step-time "
+                         f"share {sb:.1%} -> {sn:.1%} ({shift:+.2%} vs "
+                         f"{op_budget:.0%} budget, "
+                         f"{newest['bounds'].get(op, '?')}-bound)")})
+        if not shared:
+            verdicts.append({
+                "kind": "verdict", "check": "roofline",
+                "metric": "profile.op.share", "status": "pass",
+                "note": (f"pr{n_base:02d} and pr{n_new:02d} share no op "
+                         f"names (XLA renamed fusions?); floors judged, "
+                         f"drift not comparable")})
+    if not verdicts:
+        verdicts.append({"kind": "verdict", "check": "roofline",
+                         "metric": "*", "status": "fail",
+                         "note": "evidence files carry no gated values"})
+    return verdicts
 
 
 # -- checks -----------------------------------------------------------------
@@ -353,7 +475,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="Judge benchmark results against the committed "
                     "BENCH_r*.json release ladder; exit 1 on regression.")
     ap.add_argument("--check",
-                    choices=("history", "fresh", "phases", "decode"),
+                    choices=("history", "fresh", "phases", "decode",
+                             "roofline"),
                     default="history")
     ap.add_argument("--repo-dir", default=REPO,
                     help="directory holding BENCH_r*.json")
@@ -375,6 +498,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--phase-budget", type=float,
                     default=DEFAULT_PHASE_BUDGET,
                     help="phases: max absolute growth in window share")
+    ap.add_argument("--op-budget", type=float, default=DEFAULT_OP_BUDGET,
+                    help="roofline: max absolute growth in an op's share "
+                         "of modeled step time")
     ap.add_argument("--out", metavar="PATH", default=None,
                     help="also write verdict JSONL here")
     args = ap.parse_args(argv)
@@ -396,6 +522,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif args.check == "decode":
         verdicts = judge_decode(load_decode_history(args.repo_dir),
                                 noise_floor=args.noise_floor)
+    elif args.check == "roofline":
+        verdicts = judge_roofline(load_roofline_history(args.repo_dir),
+                                  op_budget=args.op_budget)
     else:
         if not (args.phases_baseline and args.phases_fresh):
             ap.error("--check phases requires --phases-baseline and "

@@ -65,7 +65,45 @@ def _fmt(v, unit_s: bool) -> str:
     return f"{v:.6g}"
 
 
-def summarize(rows: list, top: int = 20) -> str:
+def ops_view(rows: list) -> str:
+    """The ``--ops`` section: op-level roofline shares from the
+    ``profile.op.*`` metric family (published by
+    ``profiling.RooflineReport.publish()``). Honest about absence: a
+    fired ``profile.op.inventory_unavailable`` counter means the backend
+    exposed no cost model, not that the run was compute-clean."""
+    out = ["\n## op roofline (profile.op.* family)"]
+    shares = [r for r in rows if r.get("kind") == "gauge"
+              and r["name"] == "profile.op.share"]
+    coverage = next((r for r in rows if r.get("kind") == "gauge"
+                     and r["name"] == "profile.op.coverage"), None)
+    unavailable = next(
+        (r for r in rows if r.get("kind") == "counter"
+         and r["name"] == "profile.op.inventory_unavailable"), None)
+    if not shares:
+        if unavailable:
+            out.append("no cost model on this backend "
+                       "(profile.op.inventory_unavailable fired "
+                       f"{unavailable['value']}x); op attribution "
+                       "degraded to phase level")
+        else:
+            out.append("no profile.op.* rows in this artifact "
+                       "(run attribution.py --ops --run, or call "
+                       "RooflineReport.publish())")
+        return "\n".join(out)
+    if coverage is not None:
+        out.append(f"coverage: {coverage['value']:.3f} of modeled "
+                   "compute-phase FLOPs attributed to op rows")
+    ranked = sorted(shares, key=lambda r: (-r["value"], _full_name(r)))
+    width = max(len((r.get("labels") or {}).get("op", "?")) for r in ranked)
+    out.append(f"{'op':{width}s} {'share':>7s}  bound")
+    for r in ranked:
+        labels = r.get("labels") or {}
+        out.append(f"{labels.get('op', '?'):{width}s} "
+                   f"{r['value']:7.3f}  {labels.get('bound', '?')}")
+    return "\n".join(out)
+
+
+def summarize(rows: list, top: int = 20, ops_section: bool = False) -> str:
     """The whole report as one string (printed by main, asserted by tests)."""
     counters = [r for r in rows if r.get("kind") == "counter"]
     gauges = [r for r in rows if r.get("kind") == "gauge"]
@@ -112,6 +150,9 @@ def summarize(rows: list, top: int = 20) -> str:
             out.append(f"commits {r['count']}  p50 {r['p50']:g}  "
                        f"p95 {r['p95']:g}  max {r['max']:g}  "
                        f"mean {r['sum'] / r['count']:.2f}")
+
+    if ops_section:
+        out.append(ops_view(rows))
 
     if spans:
         out.append(f"\n## spans (top {top} by total duration)")
@@ -186,6 +227,10 @@ def main(argv=None):
                     help="cross-process trace view: group spans by "
                          "trace_id (rows from the i-th artifact default "
                          "to pid=i when untagged)")
+    ap.add_argument("--ops", action="store_true",
+                    help="append the op-level roofline section "
+                         "(profile.op.* gauges from "
+                         "RooflineReport.publish())")
     args = ap.parse_args(argv)
     # per-process family expansion: flush_at_exit suffixes artifacts with
     # .p{process_index}, so `run.jsonl` names a FAMILY on a shared FS —
@@ -228,7 +273,7 @@ def main(argv=None):
 
             sys.stdout.write(rows_to_prometheus(rows))
         else:
-            print(summarize(rows, top=args.top))
+            print(summarize(rows, top=args.top, ops_section=args.ops))
     except BrokenPipeError:  # e.g. `... | head`: exit quietly
         os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
 

@@ -52,6 +52,10 @@ _CONSUMER_PATHS = (
     "benchmarks/decode_bench.py",
     "benchmarks/paged_memory_probe.py",
     "benchmarks/data_probe.py",
+    "benchmarks/roofline_probe.py",
+    "distkeras_tpu/profiling/cost_model.py",
+    "distkeras_tpu/profiling/roofline.py",
+    "distkeras_tpu/profiling/capture.py",
     "distkeras_tpu/health/export.py",
     "distkeras_tpu/health/endpoints.py",
     "distkeras_tpu/health/slo.py",

@@ -133,9 +133,28 @@ def _fleet_data(rows: list) -> dict:
     return out
 
 
+def _fleet_ops(rows: list) -> list:
+    """Op-roofline digest for the fleet table (DESIGN.md §21): the top
+    ``profile.op.share`` gauges RooflineReport.publish() left behind,
+    each with its boundedness verdict. Entries appear only when a process
+    published a roofline, so fleets without op attribution pay no extra
+    line."""
+    out = []
+    for r in rows:
+        if (r.get("kind") == "gauge"
+                and r.get("name") == "profile.op.share"):
+            labels = r.get("labels") or {}
+            out.append((labels.get("op", "?"),
+                        float(r.get("value", 0.0)),
+                        labels.get("bound", "?")))
+    out.sort(key=lambda t: (-t[1], t[0]))
+    return out[:3]
+
+
 def _watch_table(workers: dict, prev: dict, interval: float,
                  fleet_alerts: list = (), fleet_versions: dict = (),
-                 fleet_decode: dict = (), fleet_data: dict = ()) -> str:
+                 fleet_decode: dict = (), fleet_data: dict = (),
+                 fleet_ops: list = ()) -> str:
     cols = ("worker", "hb_age", "windows", "win/s", "staleness",
             "degraded", "alerts", "flag")
     lines = [time.strftime("%H:%M:%S") + "  " +
@@ -169,6 +188,9 @@ def _watch_table(workers: dict, prev: dict, interval: float,
         parts += [f"{k}={v}" for k, v in sorted(fleet_data.items())
                   if k not in order]
         lines.append("          DATA: " + " ".join(parts))
+    if fleet_ops:
+        lines.append("          OPS: " + " ".join(
+            f"{op}={share:.2f}({bound})" for op, share, bound in fleet_ops))
     return "\n".join(lines)
 
 
@@ -298,7 +320,8 @@ def main(argv: Optional[list] = None) -> int:
                             fleet_alerts=_fleet_alerts(rows),
                             fleet_versions=_fleet_versions(rows),
                             fleet_decode=_fleet_decode(rows),
-                            fleet_data=_fleet_data(rows)),
+                            fleet_data=_fleet_data(rows),
+                            fleet_ops=_fleet_ops(rows)),
                             flush=True)
                         prev_windows = {w: d.get("windows", 0)
                                         for w, d in workers.items()}

@@ -115,6 +115,25 @@ def handle_health_op(op: str, header: dict,
                if key.startswith("observability.hbm_")}
         if hbm:
             status["hbm"] = hbm
+        # roofline digest: RooflineReport.publish() leaves per-op share
+        # gauges (profile.op.share{bound=...,op=...}); the status op
+        # surfaces the top-3 offenders so `watch` can show where the
+        # compiled compute actually goes — again without importing jax
+        from distkeras_tpu.health.export import _parse_key
+
+        roofline = []
+        for key, value in gauges.items():
+            name, labels = _parse_key(key)
+            if name == "profile.op.share" and "op" in labels:
+                roofline.append({"op": labels["op"],
+                                 "share": round(value, 4),
+                                 "bound": labels.get("bound", "?")})
+        if roofline:
+            roofline.sort(key=lambda r: (-r["share"], r["op"]))
+            status["roofline"] = roofline[:3]
+            cov = gauges.get("profile.op.coverage")
+            if cov is not None:
+                status["roofline_coverage"] = round(cov, 4)
         # SLO judgement (health/slo.py): active alerts of the installed
         # engine ride the digest so `watch` and the CLI see breaches live.
         # Lazy import keeps this module import-light (docstring contract).

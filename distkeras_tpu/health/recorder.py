@@ -101,6 +101,7 @@ class FlightRecorder:
             maxlen=self.capacity)
         self.dump_dir: Optional[str] = None
         self.fingerprint: Dict[str, Any] = {}
+        self.roofline: Optional[Dict[str, Any]] = None
         self.last_dump_path: Optional[str] = None
         # distinct reasons already auto-dumped: one bundle per failure
         # class per process, not one per retry of the same failure
@@ -126,6 +127,14 @@ class FlightRecorder:
         the bundle fingerprint; trainers stamp these at train() start."""
         self.fingerprint.update(
             {k: v for k, v in fields.items() if v is not None})
+
+    def set_roofline(self, digest: Dict[str, Any]) -> None:
+        """Stamp the latest op-roofline digest (a plain dict from
+        ``profiling.RooflineReport.digest()``) so postmortem bundles say
+        where the compiled compute was going when the run died. The
+        profiling layer duck-types this setter — the recorder itself
+        stays jax-free (it only stores the dict)."""
+        self.roofline = dict(digest)
 
     def events(self) -> List[dict]:
         """The ring as row dicts (oldest first)."""
@@ -165,6 +174,7 @@ class FlightRecorder:
             "git_sha": _git_sha(os.path.dirname(os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__))))),
             "fingerprint": dict(self.fingerprint),
+            "roofline": dict(self.roofline) if self.roofline else None,
             "last_trace_ids": self.last_trace_ids(),
             "status": status,
             "events": self.events(),
@@ -213,6 +223,7 @@ class FlightRecorder:
     def clear(self) -> None:
         self._ring.clear()
         self._dumped_reasons.clear()
+        self.roofline = None
         self.last_dump_path = None
 
 

@@ -34,7 +34,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from distkeras_tpu import comms, engine, telemetry
+from distkeras_tpu import comms, engine, observability, telemetry
+from distkeras_tpu import precision as precision_lib
 from distkeras_tpu.data.prefetch import prefetch
 from distkeras_tpu.health import recorder as flight_recorder
 from distkeras_tpu.health.heartbeat import (HeartbeatPublisher,
@@ -225,6 +226,19 @@ class HostAsyncRunner:
         # watchdog=...)) because its policies can abort training
         self.heartbeat = HeartbeatPublisher()
         self.straggler = StragglerDetector()
+        # live per-window MFU series (DESIGN.md §21 satellite): bookkeep
+        # publishes observability.mfu every window so the mfu-floor SLO
+        # burns on current data, not a stale end-of-run gauge. The window
+        # FLOPs count is one make_jaxpr trace, taken lazily on the first
+        # window and ONLY once a peak ceiling is known — on CPU hosts
+        # device_peak_flops is None and the whole path stays cold.
+        policy = precision_lib.get_policy(precision)
+        self.mfu_dtype = policy.mfu_dtype if policy is not None else "bf16"
+        self.mfu_peak_flops: Optional[float] = None  # bench/test override
+        self._mfu_peak: Optional[float] = None
+        self._mfu_peak_resolved = False
+        self._window_flops: Optional[float] = None
+        self._mfu_lock = threading.Lock()
         self.worker_devices: list = []  # actual placement, for tests/logs
         self.window_clocks: list = []   # merged commit clocks, last run
         self.merged_windows: list = []  # (clock, staleness, steps) tuples
@@ -374,6 +388,7 @@ class HostAsyncRunner:
                     self.heartbeat.publish(wid, clock_at_fold, staleness,
                                            win_s)
                     self.straggler.observe(wid, win_s)
+                    self._publish_window_mfu(win_s)
                     if checkpointing and cadence.crossed(clock_at_fold):
                         save_trigger.set()  # non-blocking hand-off
                     if watchdog is not None:
@@ -480,6 +495,51 @@ class HostAsyncRunner:
         center, _ = base_ps.pull()
         return device_get_batched(center), history, stal, ps.num_updates
 
+    def _mfu_ceiling(self) -> Optional[float]:
+        """Peak FLOP/s the per-window MFU series measures against: the
+        explicit ``mfu_peak_flops`` override (bench/test seam) or the
+        device's dtype-aware table entry; None (CPU) disables the series
+        — declining beats fabricating, same rule as ``calibrate_peak``."""
+        if self.mfu_peak_flops is not None:
+            return self.mfu_peak_flops
+        if not self._mfu_peak_resolved:
+            self._mfu_peak_resolved = True
+            try:
+                self._mfu_peak = observability.device_peak_flops(
+                    self.devices[0], dtype=self.mfu_dtype)
+            except Exception:
+                self._mfu_peak = None
+        return self._mfu_peak
+
+    def _note_window_flops(self, *args) -> None:
+        """Count one window's model FLOPs (a single make_jaxpr trace) the
+        first time a worker reaches its window; skipped entirely while no
+        peak ceiling is known, so the default CPU path never pays it."""
+        if self._window_flops is not None or self._mfu_ceiling() is None:
+            return
+        with self._mfu_lock:
+            if self._window_flops is None:
+                try:
+                    self._window_flops = observability.count_flops(
+                        self.window_fn, *args)
+                except Exception:
+                    self._window_flops = 0.0  # can't count: stay silent
+
+    def _publish_window_mfu(self, win_s: float) -> None:
+        if not self._window_flops or win_s <= 0:
+            return
+        peak = self._mfu_ceiling()
+        if peak is None:
+            return
+        value = observability.mfu(self._window_flops, win_s,
+                                  peak_per_chip=peak,
+                                  dtype=self.mfu_dtype)
+        if value is not None:
+            # the gauge (inside mfu()) carries "now"; the histogram keeps
+            # the whole window series for burn-rate math and summaries
+            telemetry.histogram("observability.mfu_window",
+                                dtype=self.mfu_dtype).record(value)
+
     def _serial_rounds(self, k, wid, dev, carry, ps, elastic, rounds,
                        abort, bookkeep, pull_h, win_h, commit_h):
         """The serialized pull → window → commit loop, with the elastic
@@ -542,6 +602,8 @@ class HostAsyncRunner:
                 t_h2d = time.perf_counter()
                 prof["h2d"].record(t_h2d - t1)
                 phases["h2d"] = t_h2d - t1
+                self._note_window_flops(carry, center_dev, batches,
+                                        np.int32(wid * 1_000_003 + fold))
                 with telemetry.span("trace.compute", worker=wid):
                     carry, commit, ms = self.window_fn(
                         carry, center_dev, batches,
@@ -693,8 +755,11 @@ class HostAsyncRunner:
                     # clock arrived with this response
                     bookkeep(clock_at_fold, *pending)
                 t1 = time.perf_counter()
+                center_dev = jax.device_put(center, dev)
+                self._note_window_flops(carry, center_dev, batches,
+                                        np.int32(wid * 1_000_003 + fold))
                 carry, commit, ms = self.window_fn(
-                    carry, jax.device_put(center, dev), batches,
+                    carry, center_dev, batches,
                     np.int32(wid * 1_000_003 + fold))
                 jax.block_until_ready(commit)
                 win_s = time.perf_counter() - t1

@@ -1,0 +1,173 @@
+"""Opt-in measured op timing via ``jax.profiler`` trace capture.
+
+NEVER default-on: the device profiler perturbs the step it measures and
+writes trace files, so every entry point here is an explicit call —
+``attribution.py --ops --capture`` is the only wired caller. The default
+path stays cold (the paired off/on probe in attribution pins it ≤2%).
+
+The capture runs N steps under ``jax.profiler.trace`` and parses the
+resulting ``*.xplane.pb`` with a ~60-line varint walker (the container has
+no tensorflow/tensorboard profile reader, and the XSpace wire format is
+four nested messages: XSpace.planes(1) → XPlane{name=2, lines=3,
+event_metadata=4} → XLine.events(4) → XEvent{metadata_id=1,
+duration_ps=3}). Only *device* planes are read — host-side Python timing
+is the phase table's job, not this one. When no device plane exists (CPU
+hosts) or the trace is unparseable, the condition is counted once
+(``profile.op.capture_unavailable``) and a typed empty table comes back —
+the report then ranks by modeled time, honestly labeled.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, Tuple
+
+from distkeras_tpu import telemetry
+
+
+def _varint(buf: bytes, i: int) -> Tuple[int, int]:
+    shift = result = 0
+    while True:
+        b = buf[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, i
+        shift += 7
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field_number, wire_type, value) over one message's bytes."""
+    i, n = 0, len(buf)
+    while i < n:
+        key, i = _varint(buf, i)
+        fnum, wt = key >> 3, key & 7
+        if wt == 0:
+            val, i = _varint(buf, i)
+        elif wt == 1:
+            val, i = buf[i:i + 8], i + 8
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            val, i = buf[i:i + ln], i + ln
+        elif wt == 5:
+            val, i = buf[i:i + 4], i + 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fnum, wt, val
+
+
+@dataclass
+class OpTimeTable:
+    """Per-op measured seconds (summed over captured steps, then divided
+    by steps → per-step). ``available=False`` means no device trace."""
+    seconds: Dict[str, float] = field(default_factory=dict)
+    available: bool = True
+    note: str = ""
+    steps: int = 0
+
+    def total(self) -> float:
+        return sum(self.seconds.values())
+
+
+def parse_xplane(data: bytes) -> Dict[str, float]:
+    """Sum XEvent durations (ps → s) per event-metadata name across every
+    *device* plane of one serialized XSpace."""
+    out: Dict[str, float] = {}
+    for fnum, wt, plane in _fields(data):
+        if fnum != 1 or wt != 2:
+            continue
+        name = b""
+        meta: Dict[int, str] = {}
+        lines = []
+        for pf, pw, pv in _fields(plane):
+            if pf == 2 and pw == 2:
+                name = pv
+            elif pf == 3 and pw == 2:
+                lines.append(pv)
+            elif pf == 4 and pw == 2:
+                # map<int64, XEventMetadata>: entry{key=1, value=2}
+                mid, mname = None, b""
+                for ef, ew, ev in _fields(pv):
+                    if ef == 1 and ew == 0:
+                        mid = ev
+                    elif ef == 2 and ew == 2:
+                        for mf, mw, mv in _fields(ev):
+                            if mf == 1 and mw == 0 and mid is None:
+                                mid = mv
+                            elif mf == 2 and mw == 2:
+                                mname = mv
+                if mid is not None:
+                    meta[mid] = mname.decode("utf-8", "replace")
+        plane_name = name.decode("utf-8", "replace")
+        if "/device:" not in plane_name.lower() \
+                and "/tpu:" not in plane_name.lower():
+            continue  # host planes measure Python, not the accelerator
+        for line in lines:
+            for lf, lw, lv in _fields(line):
+                if lf != 4 or lw != 2:
+                    continue
+                metadata_id, dur_ps = None, 0
+                for xf, xw, xv in _fields(lv):
+                    if xf == 1 and xw == 0:
+                        metadata_id = xv
+                    elif xf == 3 and xw == 0:
+                        dur_ps = xv
+                op = meta.get(metadata_id)
+                if op:
+                    out[op] = out.get(op, 0.0) + dur_ps * 1e-12
+    return out
+
+
+_capture_noted = False
+
+
+def _note_unavailable(note: str, steps: int = 0) -> OpTimeTable:
+    global _capture_noted
+    if not _capture_noted:
+        _capture_noted = True
+        telemetry.counter("profile.op.capture_unavailable").inc()
+    return OpTimeTable(available=False, note=note, steps=steps)
+
+
+def capture_op_times(step_fn: Callable[[], object], steps: int = 3,
+                     logdir: str = None) -> OpTimeTable:
+    """Run ``step_fn`` N times under the device profiler and return
+    per-step measured seconds per op name.
+
+    ``step_fn`` must be a zero-arg closure over already-compiled work; its
+    return value is blocked on so the device timeline closes before the
+    trace stops. Opt-in only — see the module docstring.
+    """
+    import jax
+
+    owned = logdir is None
+    if owned:
+        logdir = tempfile.mkdtemp(prefix="dkt_opcapture_")
+    try:
+        with jax.profiler.trace(logdir):
+            for _ in range(max(1, steps)):
+                out = step_fn()
+                jax.block_until_ready(out)
+    except Exception as exc:  # profiler not supported on this backend
+        return _note_unavailable(f"profiler trace failed: {exc!r}", steps)
+    paths = sorted(glob.glob(
+        os.path.join(logdir, "**", "*.xplane.pb"), recursive=True))
+    if not paths:
+        return _note_unavailable("no xplane.pb produced", steps)
+    seconds: Dict[str, float] = {}
+    try:
+        for path in paths:
+            with open(path, "rb") as f:
+                for op, s in parse_xplane(f.read()).items():
+                    seconds[op] = seconds.get(op, 0.0) + s
+    except Exception as exc:
+        return _note_unavailable(f"xplane parse failed: {exc!r}", steps)
+    if not seconds:
+        return _note_unavailable(
+            "no device plane in trace (CPU host: measured op timing "
+            "needs an accelerator)", steps)
+    per_step = {op: s / max(1, steps) for op, s in seconds.items()}
+    return OpTimeTable(seconds=per_step, steps=steps)

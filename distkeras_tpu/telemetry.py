@@ -156,6 +156,7 @@ METRIC_NAMES = {
     "observability.flops.while_floor": "counter",
     "observability.flops_per_step": "gauge",
     "observability.mfu": "gauge",
+    "observability.mfu_window": "histogram",
     "observability.peak_flops": "gauge",
     # in-process parameter servers
     "ps.commit.count": "counter",
@@ -279,6 +280,14 @@ METRIC_NAMES = {
     "profile.phase.h2d_s": "histogram",
     "profile.phase.pull_s": "histogram",
     "profile.phase.window_s": "histogram",
+    # op-level attribution (DESIGN.md §21): roofline coverage + per-op
+    # time shares, plus the once-per-process degradation counters for
+    # backends without a cost model / device profiler. Per-op labeled
+    # variants ride the "profile.op." family below.
+    "profile.op.capture_unavailable": "counter",
+    "profile.op.coverage": "gauge",
+    "profile.op.inventory_unavailable": "counter",
+    "profile.op.share": "gauge",
     # span names (the `with span("..."):` vocabulary; each also emits a
     # `span.<name>.duration_s` histogram via the prefix family below)
     "serving.compile": "span",
@@ -322,6 +331,8 @@ METRIC_PREFIXES = {
     "trace.": "span",
     # step-time decomposition phases (benchmarks/attribution.py)
     "profile.phase.": "histogram",
+    # op-level roofline shares (profiling/roofline.py), labeled per op
+    "profile.op.": "gauge",
 }
 
 
