@@ -1,0 +1,369 @@
+"""Probe the routed serving fleet: affinity win, replica kill, KV handoff.
+
+The end-to-end demo of DESIGN.md §22: N in-process replicas (each a real
+loopback :class:`~distkeras_tpu.serving.ServingServer` with a
+paged+prefix :class:`~distkeras_tpu.serving.GenerationEngine`) behind
+one :class:`~distkeras_tpu.serving.FleetRouter`. Four legs:
+
+affinity / random
+    Two fresh 2-replica fleets serve IDENTICAL two-round traffic; the
+    only difference is the routing policy (the seeded random leg is the
+    control). Each leg reports the fleet-wide prefix-cache hit rate; the
+    summary row carries ``affinity_advantage`` (affinity minus random),
+    which the regression gate floors strictly above zero — the affinity
+    map must be a fleet property, not a per-process accident.
+
+kill
+    A 3-replica fleet takes a concurrent storm while the replica owning
+    warm cache entries is hard-killed mid-traffic (listener down, engine
+    dead — what a lost host looks like). Every request must re-queue
+    onto a survivor and land token-exact against the local greedy
+    reference: ``success_rate`` is 1.0 or the probe exits nonzero.
+
+handoff
+    A prefill+decode pair: the routed result must be token-identical to
+    the local greedy reference with exactly one ``kv_export``/
+    ``kv_handoff`` shipment, then a torn handoff (``fleet.kv_handoff``
+    chaos) must degrade to cold prefill with the SAME tokens.
+
+Usage:
+  python benchmarks/fleet_probe.py [--prompts 6] [--rounds 2]
+                                   [--new-tokens 4] [--jsonl out.jsonl]
+
+CPU-safe: gpt_tiny replicas over loopback TCP, greedy decode only. The
+gated numbers are robustness ratios and exact-token checks, never raw
+wall clocks (CPU hosts are noisy); throughputs are printed for context.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+try:
+    import distkeras_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # running from a source checkout: use the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+MLP_FEATS = 4
+
+#: counters that tell the churn/handoff story, in print order
+FLEET_COUNTERS = (
+    "fleet.requests",
+    "fleet.requeued",
+    "fleet.evictions",
+    "fleet.sheds",
+    "fleet.handoffs",
+    "fleet.handoff_failures",
+    "fleet.affinity.hits",
+    "fleet.affinity.misses",
+    "serving.decode.prefix.exports",
+    "serving.decode.prefix.imports",
+)
+
+
+def _counter_totals() -> dict:
+    """Sum each FLEET_COUNTERS series over its labels."""
+    from distkeras_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    snapshot = reg.snapshot() if reg else {"counters": {}}
+    totals = {name: 0 for name in FLEET_COUNTERS}
+    for key, value in snapshot.get("counters", {}).items():
+        base = key.split("{", 1)[0]
+        if base in totals:
+            totals[base] += int(value)
+    return totals
+
+
+def _setup():
+    """Build the shared model stack + the local greedy reference (one
+    jitted full forward per step — slow, but unarguably correct)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distkeras_tpu.models.gpt import gpt_tiny
+    from distkeras_tpu.models.mlp import MLP
+
+    model = gpt_tiny()
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    mlp = MLP(features=(8,), num_classes=2)
+    mlp_params = mlp.init(jax.random.key(0), jnp.zeros((1, MLP_FEATS)),
+                          train=False)["params"]
+    full = jax.jit(lambda p, ids: model.apply({"params": p}, ids))
+
+    def greedy_ref(prompt, steps):
+        seq, out = list(prompt), []
+        for _ in range(steps):
+            pad = np.zeros((1, model.max_len), np.int32)
+            pad[0, :len(seq)] = seq
+            tok = int(np.argmax(
+                np.asarray(full(params, pad))[0, len(seq) - 1]))
+            out.append(tok)
+            seq.append(tok)
+        return out
+
+    return (model, params, mlp, mlp_params), greedy_ref
+
+
+class _Fleet:
+    """N in-process loopback replicas behind one FleetRouter — the same
+    harness tests/test_serving_fleet.py drives."""
+
+    def __init__(self, stack, roles, **router_kw):
+        from distkeras_tpu.serving import (FleetRouter, GenerationEngine,
+                                           ServingEngine, ServingServer)
+
+        model, params, mlp, mlp_params = stack
+        self.router = FleetRouter(**router_kw)
+        self.replicas = []
+        for role in roles:
+            gen = GenerationEngine(model, params, num_slots=2,
+                                   prefill_buckets=(8, 32), page_size=16,
+                                   prefix_cache_bytes=4 << 20)
+            eng = ServingEngine(mlp, mlp_params, input_shape=(MLP_FEATS,),
+                                buckets=(1, 8), max_wait_ms=1.0)
+            srv = ServingServer(eng, host="127.0.0.1", generator=gen,
+                                router=self.router)
+            srv.start()
+            rid = self.router.add_replica(f"127.0.0.1:{srv.port}",
+                                          role=role)
+            self.replicas.append({"rid": rid, "gen": gen, "eng": eng,
+                                  "srv": srv})
+
+    def prefix_hit_rate(self) -> float:
+        hits = misses = 0
+        for rep in self.replicas:
+            pc = rep["gen"].health_status()["prefix_cache"]
+            hits += pc["hits"]
+            misses += pc["misses"]
+        return hits / (hits + misses) if hits + misses else 0.0
+
+    def kill(self, i):
+        rep = self.replicas[i]
+        rep["srv"].stop()
+        rep["gen"].shutdown(drain=False, timeout=10.0)
+
+    def close(self):
+        self.router.close()
+        for rep in self.replicas:
+            rep["srv"].stop()
+            rep["gen"].shutdown(drain=False, timeout=10.0)
+            rep["eng"].shutdown(drain=False)
+
+
+def _prompt(n, seed=0):
+    import numpy as np
+
+    return np.random.default_rng(seed).integers(1, 256, size=n,
+                                                dtype=np.int64).tolist()
+
+
+def run_routing_leg(stack, routing: str, num_prompts: int = 6,
+                    rounds: int = 2, new_tokens: int = 4,
+                    seed: int = 0) -> dict:
+    """One fresh 2-replica fleet, ``rounds`` identical passes over the
+    same prompts; the fleet-wide prefix hit rate IS the routing policy's
+    score (round two is all repeats — affinity turns them into hits)."""
+    from distkeras_tpu import telemetry
+
+    telemetry.reset()
+    fleet = _Fleet(stack, roles=("both", "both"), routing=routing,
+                   seed=seed)
+    prompts = [_prompt(8, seed=20 + s) for s in range(num_prompts)]
+    n = 0
+    t0 = time.perf_counter()
+    try:
+        for _ in range(rounds):
+            for p in prompts:
+                fleet.router.generate(p, max_new_tokens=new_tokens)
+                n += 1
+        dt = time.perf_counter() - t0
+        rate = fleet.prefix_hit_rate()
+        d = fleet.router.status_digest()
+    finally:
+        fleet.close()
+    return {"routing": routing, "requests": n, "seconds": dt,
+            "requests_per_s": n / dt, "prefix_hit_rate": rate,
+            "affinity_hits": d["affinity"]["hits"],
+            "affinity_entries": d["affinity"]["entries"]}
+
+
+def run_kill_leg(stack, greedy_ref, num_prompts: int = 6,
+                 new_tokens: int = 6) -> dict:
+    """Warm pass, concurrent storm with a mid-storm replica kill, then a
+    deterministic post-kill pass (at least one prompt is still affine to
+    the dead replica and must re-queue). Every result is checked
+    token-exact against the local greedy reference."""
+    from distkeras_tpu import telemetry
+
+    telemetry.reset()
+    fleet = _Fleet(stack, roles=("both", "both", "both"))
+    prompts = [_prompt(8, seed=s) for s in range(num_prompts)]
+    want = {tuple(p): greedy_ref(p, new_tokens) for p in prompts}
+    total = failed = wrong = 0
+
+    def _score(p, res):
+        nonlocal wrong
+        if res.tokens.tolist() != want[tuple(p)]:
+            wrong += 1
+
+    t0 = time.perf_counter()
+    try:
+        for p in prompts:  # warm pass: spread traffic, seed the caches
+            total += 1
+            _score(p, fleet.router.generate(p, max_new_tokens=new_tokens))
+        victim = next(i for i, rep in enumerate(fleet.replicas)
+                      if rep["gen"].health_status()["prefix_cache"]
+                      ["entries"] > 0)
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            futs = [(p, pool.submit(fleet.router.generate, p,
+                                    max_new_tokens=new_tokens))
+                    for p in prompts for _ in range(2)]
+            time.sleep(0.05)
+            fleet.kill(victim)
+            for p, fut in futs:
+                total += 1
+                try:
+                    _score(p, fut.result(timeout=120))
+                except Exception:
+                    failed += 1
+        for p in prompts:  # post-kill pass: the death is now deterministic
+            total += 1
+            try:
+                _score(p, fleet.router.generate(p,
+                                                max_new_tokens=new_tokens))
+            except Exception:
+                failed += 1
+        dt = time.perf_counter() - t0
+        d = fleet.router.status_digest()
+        counters = _counter_totals()
+    finally:
+        fleet.close()
+    ok = total - failed - wrong
+    return {"requests": total, "failed": failed, "wrong_tokens": wrong,
+            "success_rate": ok / total, "seconds": dt,
+            "requests_per_s": total / dt, "requeued": d["requeued"],
+            "evictions": d["evictions"], "survivors": len(d["replicas"]),
+            "counters": counters}
+
+
+def run_handoff_leg(stack, greedy_ref, new_tokens: int = 8) -> dict:
+    """Disaggregated prefill→decode, then the torn-handoff chaos drill.
+    Both legs must be token-identical to the local reference — the
+    handoff buys latency, never different tokens."""
+    from distkeras_tpu import telemetry
+    from distkeras_tpu.utils import fault
+
+    telemetry.reset()
+    fault.clear_chaos()
+    fleet = _Fleet(stack, roles=("prefill", "decode"))
+    try:
+        prompt = _prompt(12, seed=7)
+        res = fleet.router.generate(prompt, max_new_tokens=new_tokens)
+        clean_ok = res.tokens.tolist() == greedy_ref(prompt, new_tokens)
+        handoffs = fleet.router.status_digest()["handoffs"]
+
+        fault.inject_chaos("fleet.kv_handoff", "torn")
+        prompt2 = _prompt(10, seed=8)
+        res2 = fleet.router.generate(prompt2, max_new_tokens=new_tokens)
+        chaos_ok = res2.tokens.tolist() == greedy_ref(prompt2, new_tokens)
+        d = fleet.router.status_digest()
+    finally:
+        fault.clear_chaos()
+        fleet.close()
+    return {"token_identical": float(clean_ok and chaos_ok),
+            "clean_identical": clean_ok, "chaos_identical": chaos_ok,
+            "handoffs": handoffs,
+            "handoff_failures": d["handoff_failures"]}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="affinity-vs-random, replica-kill and KV-handoff "
+                    "probe of the routed serving fleet")
+    ap.add_argument("--prompts", type=int, default=6)
+    ap.add_argument("--rounds", type=int, default=2)
+    ap.add_argument("--new-tokens", type=int, default=4)
+    ap.add_argument("--jsonl", type=str, default=None,
+                    help="append one JSON line per leg + a summary row")
+    args = ap.parse_args(argv)
+
+    stack, greedy_ref = _setup()
+    legs = []
+
+    affinity = run_routing_leg(stack, "affinity",
+                               num_prompts=args.prompts,
+                               rounds=args.rounds,
+                               new_tokens=args.new_tokens)
+    legs.append(("affinity", affinity))
+    random_leg = run_routing_leg(stack, "random",
+                                 num_prompts=args.prompts,
+                                 rounds=args.rounds,
+                                 new_tokens=args.new_tokens)
+    legs.append(("random", random_leg))
+    for name, leg in legs:
+        print(f"{name:8s}: {leg['requests']} requests in "
+              f"{leg['seconds']:.2f}s ({leg['requests_per_s']:.1f} req/s), "
+              f"fleet prefix hit rate {leg['prefix_hit_rate']:.3f}")
+
+    kill = run_kill_leg(stack, greedy_ref, num_prompts=args.prompts)
+    legs.append(("kill", kill))
+    print(f"kill    : {kill['requests']} requests through a mid-storm "
+          f"replica kill in {kill['seconds']:.2f}s — failed="
+          f"{kill['failed']} wrong={kill['wrong_tokens']} "
+          f"requeued={kill['requeued']} evictions={kill['evictions']} "
+          f"survivors={kill['survivors']}")
+    for name, value in kill["counters"].items():
+        print(f"  {name}: {value}")
+
+    handoff = run_handoff_leg(stack, greedy_ref)
+    legs.append(("handoff", handoff))
+    print(f"handoff : clean={handoff['clean_identical']} "
+          f"torn-chaos={handoff['chaos_identical']} "
+          f"handoffs={handoff['handoffs']} "
+          f"failures={handoff['handoff_failures']}")
+
+    summary = {
+        "affinity_advantage": (affinity["prefix_hit_rate"]
+                               - random_leg["prefix_hit_rate"]),
+        "kill_success_rate": kill["success_rate"],
+        "handoff_token_identical": handoff["token_identical"],
+    }
+    print(f"summary : affinity_advantage="
+          f"{summary['affinity_advantage']:+.3f} "
+          f"kill_success_rate={summary['kill_success_rate']:.3f} "
+          f"handoff_token_identical="
+          f"{summary['handoff_token_identical']:.0f}")
+
+    if args.jsonl:
+        with open(args.jsonl, "a") as f:
+            for leg, result in legs:
+                f.write(json.dumps({"kind": "leg", "leg": leg,
+                                    "prompts": args.prompts,
+                                    "rounds": args.rounds,
+                                    **result}) + "\n")
+            f.write(json.dumps({"kind": "summary", **summary}) + "\n")
+        print(f"wrote {len(legs)} leg(s) + summary to {args.jsonl}")
+
+    # the probe asserts the contracts it measures — committed evidence
+    # from a run that violated them would be worse than no evidence
+    if summary["affinity_advantage"] <= 0:
+        raise SystemExit("affinity routing did NOT beat the random "
+                         "control leg")
+    if summary["kill_success_rate"] < 1.0:
+        raise SystemExit("requests failed or decoded wrong tokens "
+                         "through the replica kill")
+    if summary["handoff_token_identical"] < 1.0:
+        raise SystemExit("disaggregated handoff was not token-identical")
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,16 @@ decode (``--check decode``)
     noise-band rule as ``fresh``. Older files that predate a metric
     simply don't vote on it — absence is not a regression.
 
+fleet (``--check fleet``)
+    Learns the routed-fleet ladder from the committed
+    ``results/pr*_fleet_probe.jsonl`` files (fleet_probe.py rows) and
+    judges the newest one against the DESIGN.md §22 acceptance bars,
+    held forever: affinity routing strictly beats the seeded
+    random-routing control leg, a mid-traffic replica kill loses zero
+    requests (all token-exact), and the disaggregated KV handoff is
+    token-identical to local prefill+decode — plus the same
+    noise-banded comparison against the prior evidence file.
+
 Verdicts are JSONL rows ``{"kind": "verdict", "check": ..., "metric":
 ..., "status": "pass"|"fail", ...}`` written to ``--out`` (and stdout);
 the process exits 0 iff every verdict passed, so CI can gate on it::
@@ -63,6 +73,7 @@ the process exits 0 iff every verdict passed, so CI can gate on it::
         --phases-fresh fresh_attribution.jsonl
     python benchmarks/regression_gate.py --check decode
     python benchmarks/regression_gate.py --check roofline
+    python benchmarks/regression_gate.py --check fleet
 """
 
 from __future__ import annotations
@@ -110,6 +121,32 @@ DECODE_FLOORS = {
     "decode.speedup_vs_naive": 3.0,
     "decode.prefix.ttft_speedup": 2.0,
     "decode.spec.speedup_vs_plain": 1.0,
+}
+
+#: fleet-probe row field -> gated metric name, keyed by the row's leg
+#: (or its ``kind`` for the summary row). The gate names deliberately
+#: live in the probe's own ``fleet_probe.`` namespace: the router's
+#: ``fleet.*`` telemetry names are live instruments, these are derived
+#: cross-leg verdict inputs.
+FLEET_METRICS = {
+    "affinity": (("prefix_hit_rate", "fleet_probe.affinity_hit_rate"),),
+    "summary": (
+        ("affinity_advantage", "fleet_probe.affinity_advantage"),
+        ("kill_success_rate", "fleet_probe.kill_success_rate"),
+        ("handoff_token_identical",
+         "fleet_probe.handoff_token_identical"),
+    ),
+}
+
+#: absolute floors from the fleet charter (ISSUE 17 / DESIGN.md §22
+#: acceptance, held forever): affinity routing strictly beats the
+#: seeded random control, a mid-traffic replica kill loses NOTHING
+#: (every request re-queues and lands token-exact), and the
+#: disaggregated KV handoff is token-identical to local prefill+decode.
+FLEET_FLOORS = {
+    "fleet_probe.affinity_advantage": 0.01,
+    "fleet_probe.kill_success_rate": 1.0,
+    "fleet_probe.handoff_token_identical": 1.0,
 }
 
 
@@ -178,6 +215,38 @@ def load_decode_history(repo_dir: str = REPO) -> List[Tuple[int, dict]]:
                     row = json.loads(line)
                     for field, name in DECODE_METRICS.get(
                             row.get("mode"), ()):
+                        if row.get(field) is not None:
+                            metrics[name] = row[field]
+        except (OSError, ValueError):
+            continue
+        if metrics:
+            out.append((int(m.group(1)), metrics))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def load_fleet_history(repo_dir: str = REPO) -> List[Tuple[int, dict]]:
+    """``[(pr_n, metrics_dict), ...]`` sorted by PR, from the committed
+    ``benchmarks/results/pr*_fleet_probe.jsonl`` evidence files
+    (fleet_probe.py rows). Metrics are extracted per FLEET_METRICS."""
+    out = []
+    pattern = os.path.join(repo_dir, "benchmarks", "results",
+                           "pr*_fleet_probe.jsonl")
+    for path in sorted(glob.glob(pattern)):
+        m = re.search(r"pr(\d+)_fleet_probe\.jsonl$", path)
+        if m is None:
+            continue
+        metrics: dict = {}
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    row = json.loads(line)
+                    key = (row.get("leg") if row.get("kind") == "leg"
+                           else row.get("kind"))
+                    for field, name in FLEET_METRICS.get(key, ()):
                         if row.get(field) is not None:
                             metrics[name] = row[field]
         except (OSError, ValueError):
@@ -407,16 +476,15 @@ def judge_phases(baseline_jsonl: str, fresh_jsonl: str,
     return verdicts
 
 
-def judge_decode(history: List[Tuple[int, dict]],
-                 floors: dict = DECODE_FLOORS,
-                 noise_floor: float = DEFAULT_NOISE_FLOOR) -> List[dict]:
-    """Serving-decode ladder gate: newest evidence file vs the charter
-    floors AND vs its own history (per-metric sub-ladder, noise-banded
-    like ``fresh``)."""
+def _judge_ladder(check: str, history: List[Tuple[int, dict]],
+                  floors: dict, noise_floor: float,
+                  missing_note: str) -> List[dict]:
+    """Shared per-PR evidence-ladder gate: the newest evidence file is
+    judged against absolute charter floors AND against its own history
+    (per-metric sub-ladder, noise-banded like ``fresh``)."""
     if not history:
-        return [{"kind": "verdict", "check": "decode", "metric": "*",
-                 "status": "fail",
-                 "note": "no pr*_decode_bench.jsonl evidence committed"}]
+        return [{"kind": "verdict", "check": check, "metric": "*",
+                 "status": "fail", "note": missing_note}]
     n_new, newest = history[-1]
     verdicts = []
     for metric in sorted(newest):
@@ -425,7 +493,7 @@ def judge_decode(history: List[Tuple[int, dict]],
         if floor is not None:
             status = "pass" if vn >= floor else "fail"
             verdicts.append({
-                "kind": "verdict", "check": "decode", "metric": metric,
+                "kind": "verdict", "check": check, "metric": metric,
                 "release": n_new, "observed": vn, "floor": floor,
                 "status": status,
                 "note": (f"pr{n_new:02d} {metric} {vn:.3f} vs charter "
@@ -436,10 +504,10 @@ def judge_decode(history: List[Tuple[int, dict]],
         n_base, base = sub[-2]
         vb = base[metric]
         band = noise_band(sub, metric, floor=noise_floor)
-        delta = (vn - vb) / abs(vb)
+        delta = (vn - vb) / abs(vb) if vb else vn - vb
         status = "pass" if delta >= -band else "fail"
         verdicts.append({
-            "kind": "verdict", "check": "decode", "metric": metric,
+            "kind": "verdict", "check": check, "metric": metric,
             "baseline_release": n_base, "release": n_new,
             "baseline": vb, "observed": vn,
             "delta_frac": round(delta, 6), "noise_band": round(band, 6),
@@ -448,10 +516,31 @@ def judge_decode(history: List[Tuple[int, dict]],
                      f"{vb:.3f} -> {vn:.3f} ({delta:+.2%}, noise band "
                      f"±{band:.2%})")})
     if not verdicts:
-        verdicts.append({"kind": "verdict", "check": "decode",
+        verdicts.append({"kind": "verdict", "check": check,
                          "metric": "*", "status": "fail",
                          "note": "evidence files carry no gated metrics"})
     return verdicts
+
+
+def judge_decode(history: List[Tuple[int, dict]],
+                 floors: dict = DECODE_FLOORS,
+                 noise_floor: float = DEFAULT_NOISE_FLOOR) -> List[dict]:
+    """Serving-decode ladder gate (see :func:`_judge_ladder`)."""
+    return _judge_ladder(
+        "decode", history, floors, noise_floor,
+        "no pr*_decode_bench.jsonl evidence committed")
+
+
+def judge_fleet(history: List[Tuple[int, dict]],
+                floors: dict = FLEET_FLOORS,
+                noise_floor: float = DEFAULT_NOISE_FLOOR) -> List[dict]:
+    """Routed-fleet ladder gate (see :func:`_judge_ladder`): affinity
+    advantage strictly positive, replica-kill success rate 1.0, KV
+    handoff token-identical — the DESIGN.md §22 acceptance bars."""
+    return _judge_ladder(
+        "fleet", history, floors, noise_floor,
+        "no pr*_fleet_probe.jsonl evidence committed "
+        "(run benchmarks/fleet_probe.py --jsonl)")
 
 
 # -- CLI --------------------------------------------------------------------
@@ -476,7 +565,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "BENCH_r*.json release ladder; exit 1 on regression.")
     ap.add_argument("--check",
                     choices=("history", "fresh", "phases", "decode",
-                             "roofline"),
+                             "roofline", "fleet"),
                     default="history")
     ap.add_argument("--repo-dir", default=REPO,
                     help="directory holding BENCH_r*.json")
@@ -522,6 +611,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif args.check == "decode":
         verdicts = judge_decode(load_decode_history(args.repo_dir),
                                 noise_floor=args.noise_floor)
+    elif args.check == "fleet":
+        verdicts = judge_fleet(load_fleet_history(args.repo_dir),
+                               noise_floor=args.noise_floor)
     elif args.check == "roofline":
         verdicts = judge_roofline(load_roofline_history(args.repo_dir),
                                   op_budget=args.op_budget)

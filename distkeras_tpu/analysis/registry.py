@@ -53,6 +53,7 @@ _CONSUMER_PATHS = (
     "benchmarks/paged_memory_probe.py",
     "benchmarks/data_probe.py",
     "benchmarks/roofline_probe.py",
+    "benchmarks/fleet_probe.py",
     "distkeras_tpu/profiling/cost_model.py",
     "distkeras_tpu/profiling/roofline.py",
     "distkeras_tpu/profiling/capture.py",

@@ -133,6 +133,45 @@ def _fleet_data(rows: list) -> dict:
     return out
 
 
+def _fleet_router(rows: list) -> dict:
+    """Routed-serving-fleet digest for the fleet table (DESIGN.md §22):
+    live replicas by role, the worst per-replica queue depth, version
+    skew, affinity hit rate, and the router's shed/re-queue/handoff
+    tallies. Keys appear only when a FleetRouter exports the metrics,
+    so router-less fleets pay no extra line."""
+    out: dict = {}
+    roles: dict = {}
+    depth = None
+    tallies = {"fleet.sheds": "sheds", "fleet.requeued": "requeued",
+               "fleet.handoffs": "handoffs",
+               "fleet.handoff_failures": "handoff_failures",
+               "fleet.evictions": "evictions"}
+    for r in rows:
+        name, kind = r.get("name"), r.get("kind")
+        labels = r.get("labels") or {}
+        if kind == "gauge" and name == "fleet.replicas":
+            n = int(r.get("value", 0))
+            if n:
+                roles[labels.get("role", "?")] = n
+        elif kind == "gauge" and name == "fleet.replica.queue_depth":
+            v = float(r.get("value", 0.0))
+            depth = v if depth is None else max(depth, v)
+        elif kind == "gauge" and name == "fleet.version_skew":
+            out["skew"] = int(r.get("value", 0))
+        elif kind == "gauge" and name == "fleet.affinity.hit_rate":
+            out["affinity"] = round(float(r.get("value", 0.0)), 2)
+        elif kind == "counter" and name in tallies:
+            out[tallies[name]] = int(r.get("value", 0))
+    if roles:
+        out["replicas"] = sum(roles.values())
+        # compact role spread: p=prefill, d=decode, b=both
+        out["roles"] = "/".join(f"{k[:1]}{v}"
+                                for k, v in sorted(roles.items()))
+    if depth is not None:
+        out["depth_max"] = depth
+    return out
+
+
 def _fleet_ops(rows: list) -> list:
     """Op-roofline digest for the fleet table (DESIGN.md §21): the top
     ``profile.op.share`` gauges RooflineReport.publish() left behind,
@@ -154,7 +193,7 @@ def _fleet_ops(rows: list) -> list:
 def _watch_table(workers: dict, prev: dict, interval: float,
                  fleet_alerts: list = (), fleet_versions: dict = (),
                  fleet_decode: dict = (), fleet_data: dict = (),
-                 fleet_ops: list = ()) -> str:
+                 fleet_ops: list = (), fleet_router: dict = ()) -> str:
     cols = ("worker", "hb_age", "windows", "win/s", "staleness",
             "degraded", "alerts", "flag")
     lines = [time.strftime("%H:%M:%S") + "  " +
@@ -191,6 +230,15 @@ def _watch_table(workers: dict, prev: dict, interval: float,
     if fleet_ops:
         lines.append("          OPS: " + " ".join(
             f"{op}={share:.2f}({bound})" for op, share, bound in fleet_ops))
+    if fleet_router:
+        order = ("replicas", "roles", "depth_max", "skew", "affinity",
+                 "sheds", "requeued", "evictions", "handoffs",
+                 "handoff_failures")
+        parts = [f"{k}={fleet_router[k]}" for k in order
+                 if k in fleet_router]
+        parts += [f"{k}={v}" for k, v in sorted(fleet_router.items())
+                  if k not in order]
+        lines.append("          FLEET: " + " ".join(parts))
     return "\n".join(lines)
 
 
@@ -321,7 +369,8 @@ def main(argv: Optional[list] = None) -> int:
                             fleet_versions=_fleet_versions(rows),
                             fleet_decode=_fleet_decode(rows),
                             fleet_data=_fleet_data(rows),
-                            fleet_ops=_fleet_ops(rows)),
+                            fleet_ops=_fleet_ops(rows),
+                            fleet_router=_fleet_router(rows)),
                             flush=True)
                         prev_windows = {w: d.get("windows", 0)
                                         for w, d in workers.items()}

@@ -23,6 +23,17 @@ Generative serving (KV-cache decode + continuous batching, DESIGN.md
     fut = gen.generate(prompt, max_new_tokens=64, stream=print)
     result = fut.result()          # GenerationResult(tokens, reason)
     gen.shutdown()
+
+The routed serving fleet (router tier over N replicas, prefix-affinity
+routing, disaggregated prefill/decode with KV handoff, DESIGN.md §22)
+lives in fleet.py:
+
+    from distkeras_tpu.serving import FleetRouter
+
+    router = FleetRouter(token=secret)
+    router.add_replica("10.0.0.2:8470", role="prefill")
+    router.add_replica("10.0.0.3:8470", role="decode")
+    result = router.generate(prompt, max_new_tokens=64)
 """
 
 from distkeras_tpu.serving.batching import (
@@ -34,6 +45,7 @@ from distkeras_tpu.serving.batching import (
 )
 from distkeras_tpu.serving.buckets import DEFAULT_BUCKETS, BucketSpec
 from distkeras_tpu.serving.engine import ServingEngine
+from distkeras_tpu.serving.fleet import FleetOverloaded, FleetRouter
 from distkeras_tpu.serving.generation import (
     GenerationEngine,
     GenerationResult,
@@ -58,6 +70,8 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "DeadlineExceeded",
     "EngineClosed",
+    "FleetOverloaded",
+    "FleetRouter",
     "GenerationEngine",
     "GenerationResult",
     "KVCachePool",

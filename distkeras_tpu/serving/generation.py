@@ -529,6 +529,11 @@ class GenerationEngine:
         self.eos_id = eos_id
         self.queue_capacity = int(queue_capacity)
         self._dq: "collections.deque[_GenRequest]" = collections.deque()
+        # cross-host prefix traffic (serving/fleet.py KV handoff,
+        # DESIGN.md §22): import/export requests from server handler
+        # threads, applied by the scheduler thread between iterations so
+        # the prefix cache keeps its single-owner (no-lock) contract
+        self._host_ops: collections.deque = collections.deque()
         self._cv = threading.Condition()
         self._closed = False
         self._drain = True
@@ -563,6 +568,10 @@ class GenerationEngine:
             "serving.decode.paged.swap_in_failures")
         self._prefix_full_c = telemetry.counter(
             "serving.decode.prefix.full_hits")
+        self._prefix_imports_c = telemetry.counter(
+            "serving.decode.prefix.imports")
+        self._prefix_exports_c = telemetry.counter(
+            "serving.decode.prefix.exports")
 
         self._compile_all()
         if self._draft is not None:
@@ -827,6 +836,94 @@ class GenerationEngine:
             telemetry.record_event("rollout", action="version_retired",
                                    engine="generation", version=stale)
 
+    # -- cross-host prefix handoff (serving/fleet.py, DESIGN.md §22) -------
+
+    def _host_op(self, kind: str, payload, timeout: float):
+        """Hand one prefix-cache operation to the scheduler thread and
+        block for its result — server handler threads must never touch
+        ``self._prefix`` directly (single-owner contract)."""
+        done = threading.Event()
+        box: list = []
+        with self._cv:
+            if self._closed:
+                return None if kind == "export" else False
+            self._host_ops.append((kind, payload, done, box))
+            self._cv.notify_all()
+        if not done.wait(timeout):
+            return None if kind == "export" else False
+        return box[0]
+
+    def export_prefix(self, tokens, timeout: float = 10.0):
+        """Host copy of the parked KV for exactly ``tokens`` — the
+        prefill half of a fleet KV handoff. Returns ``(data, last_logits)``
+        (``data`` is the host page pytree ``swap_out`` captured, sliced to
+        the prefix's pages; ``last_logits`` may be None) or None when the
+        prefix cache holds no such entry (or the engine has no cache)."""
+        tokens = tuple(int(t) for t in np.asarray(tokens).reshape(-1))
+        return self._host_op("export", tokens, timeout)
+
+    def import_prefix(self, tokens, leaves, last_logits=None,
+                      timeout: float = 10.0) -> bool:
+        """Install a shipped prefix into this engine's cache — the decode
+        half of a fleet KV handoff. ``leaves`` is the flat leaf list of an
+        :meth:`export_prefix` page pytree (the engine rebuilds the tree
+        against its OWN pool structure; a shape/dtype/leaf-count mismatch
+        is refused, never half-installed). Returns True when the entry is
+        resident; False means the caller must cold-prefill."""
+        tokens = tuple(int(t) for t in np.asarray(tokens).reshape(-1))
+        if last_logits is not None:
+            last_logits = np.asarray(last_logits)
+        return bool(self._host_op("import", (tokens, list(leaves),
+                                             last_logits), timeout))
+
+    def _apply_host_ops(self) -> None:
+        """Scheduler-thread half of import/export_prefix."""
+        import jax
+
+        while True:
+            with self._cv:
+                if not self._host_ops:
+                    return
+                kind, payload, done, box = self._host_ops.popleft()
+            try:
+                if self._prefix is None:
+                    box.append(None if kind == "export" else False)
+                elif kind == "export":
+                    entry = self._prefix.peek(payload)
+                    if entry is None:
+                        box.append(None)
+                    else:
+                        self._prefix_exports_c.inc()
+                        box.append((entry.data, entry.last_logits))
+                else:
+                    tokens, leaves, last_logits = payload
+                    treedef = jax.tree.structure(self.pool.pool)
+                    pool_leaves = jax.tree.leaves(self.pool.pool)
+                    ok = len(leaves) == len(pool_leaves) and all(
+                        l.shape[1:] == p.shape[1:] and l.dtype == p.dtype
+                        for l, p in zip(leaves, pool_leaves))
+                    if ok:
+                        data = jax.tree.unflatten(treedef, leaves)
+                        self._prefix.insert(tokens, data, last_logits)
+                        ok = self._prefix.has(tokens)
+                        if ok:
+                            self._prefix_imports_c.inc()
+                    box.append(bool(ok))
+            except Exception:  # a bad handoff must not kill the loop
+                self._swap_fail_c.inc()
+                box.append(None if kind == "export" else False)
+            finally:
+                done.set()
+
+    def _fail_host_ops(self) -> None:
+        """Unblock waiters whose op can no longer run (crash/shutdown)."""
+        with self._cv:
+            pending = list(self._host_ops)
+            self._host_ops.clear()
+        for kind, _payload, done, box in pending:
+            box.append(None if kind == "export" else False)
+            done.set()
+
     # -- client API --------------------------------------------------------
 
     def generate(self, prompt, *, max_new_tokens: Optional[int] = None,
@@ -892,7 +989,8 @@ class GenerationEngine:
             while True:
                 with self._cv:
                     while not self._dq and not active and not self._closed \
-                            and self._pending_swap is None:
+                            and self._pending_swap is None \
+                            and not self._host_ops:
                         self._cv.wait()
                     if self._closed and not self._drain:
                         pending = list(self._dq)
@@ -902,8 +1000,10 @@ class GenerationEngine:
                     if self._closed and not self._dq and not active:
                         self._fail_pending_swap(EngineClosed(
                             "engine is shut down; no weight swaps"))
+                        self._fail_host_ops()
                         return
                 self._apply_pending_swap()
+                self._apply_host_ops()
                 self._admit(active)
                 self._expire(active)
                 if active:
@@ -920,6 +1020,7 @@ class GenerationEngine:
                 self._depth_g.set(0)
             err = EngineClosed(f"generation scheduler failed: {e!r}")
             self._fail_pending_swap(err)
+            self._fail_host_ops()
             for req in pending + list(active.values()):
                 req.future.set_exception(err)
             for slot in list(active):
@@ -929,6 +1030,7 @@ class GenerationEngine:
         # non-draining shutdown: fail everything still in flight
         err = EngineClosed("engine shut down without draining")
         self._fail_pending_swap(err)
+        self._fail_host_ops()
         for req in pending + list(active.values()):
             req.future.set_exception(err)
         for slot in list(active):
@@ -1422,6 +1524,7 @@ class GenerationEngine:
             err = EngineClosed(
                 f"scheduler still running after {timeout}s shutdown join")
             self._fail_pending_swap(err)
+            self._fail_host_ops()
             for req in pending:
                 req.future.set_exception(err)
 

@@ -391,6 +391,16 @@ class PrefixCache:
         entry = self._entries.get(self._key(tokens))
         return entry is not None and entry.tokens == tokens
 
+    def peek(self, tokens) -> Optional[_PrefixEntry]:
+        """Exact-sequence fetch with no hit/miss accounting and no LRU
+        refresh — the fleet KV-handoff export path (DESIGN.md §22) reads
+        an entry to ship it without perturbing the cache's own stats."""
+        tokens = tuple(int(t) for t in tokens)
+        entry = self._entries.get(self._key(tokens))
+        if entry is not None and entry.tokens == tokens:
+            return entry
+        return None
+
     def lookup(self, prompt) -> Optional[_PrefixEntry]:
         """Longest cached prefix of ``prompt`` (LRU-refreshed), or None.
         Counted as a hit only when a prefix matches; the engine decides

@@ -21,6 +21,15 @@ Protocol (header JSON + raw blobs, see remote_ps):
     -> {"ok": ..., "version": v, "staged": ...}   (live rollout, §18)
     {"op": "version", "token": ...} -> {"model_version": v, ...}
 
+    {"op": "kv_export", "token": ..., "length": n} + blob: int32 tokens
+    -> {"found": true, "leaves": [[shape, dtype], ...], ...} + blobs:
+       one raw host KV page blob per pool leaf (+ optional parked
+       last-logits blob), or {"found": false}   (fleet KV handoff, §22)
+    {"op": "kv_handoff", "token": ..., "length": n,
+     "leaves": [[shape, dtype], ...], ...} + blobs: int32 tokens then
+     the kv_export blobs verbatim -> {"ok": bool}  (False = refused →
+     the caller degrades to cold prefill, never a half-install)
+
     {"op": "generate", "token": ..., "length": n, "max_new_tokens": m,
      "timeout_ms": ..., "eos_id": ...} + blob: int32 prompt tokens
     -> zero or more {"stream": true, "tokens": [...]} frames (one per
@@ -51,6 +60,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from distkeras_tpu import telemetry
+from distkeras_tpu.comms.retry import DEFAULT_RETRY
 from distkeras_tpu.health.endpoints import HEALTH_OPS, handle_health_op
 from distkeras_tpu.parallel.remote_ps import (
     check_token,
@@ -93,7 +103,7 @@ class ServingServer:
 
     def __init__(self, engine: ServingEngine, host: str = "0.0.0.0",
                  port: int = 0, token: Optional[str] = None,
-                 generator=None, rollout=None):
+                 generator=None, rollout=None, router=None):
         self.engine = engine
         #: optional GenerationEngine backing the ``generate`` op; None
         #: keeps this a pure one-shot inference server
@@ -102,6 +112,10 @@ class ServingServer:
         #: ``weights_put`` stages through it (canary + rollback rails)
         #: instead of swapping the engines directly
         self.rollout = rollout
+        #: optional FleetRouter (serving/fleet.py): when mounted, this
+        #: server's health ``status`` digest carries the router's fleet
+        #: view (replicas/roles/sheds/handoffs/skew) for health.cli
+        self.router = router
         self.token = token
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -189,6 +203,23 @@ class ServingServer:
             except Exception as e:
                 send_message(conn, {"error": str(e),
                                     "kind": _error_kind(e)})
+        elif op == "kv_export":
+            # fleet KV handoff, prefill side (serving/fleet.py, §22):
+            # read the parked prompt KV out of the prefix cache
+            try:
+                header2, blobs2 = self._kv_export(header, blobs)
+                send_message(conn, header2, blobs2)
+            except Exception as e:
+                send_message(conn, {"error": str(e),
+                                    "kind": _error_kind(e)})
+        elif op == "kv_handoff":
+            # fleet KV handoff, decode side: install shipped pages; the
+            # engine refuses (ok=False) on any shape/dtype mismatch
+            try:
+                send_message(conn, self._kv_handoff(header, blobs))
+            except Exception as e:
+                send_message(conn, {"error": str(e),
+                                    "kind": _error_kind(e)})
         elif op == "version":
             send_message(conn, self._version())
         elif op == "stats":
@@ -205,6 +236,8 @@ class ServingServer:
             }
             if self.generator is not None:
                 extra["decode"] = self.generator.health_status()
+            if self.router is not None:
+                extra["fleet"] = self.router.status_digest()
             send_message(conn, handle_health_op(op, header,
                                                 extra_status=extra))
         else:
@@ -349,6 +382,74 @@ class ServingServer:
                             "num_tokens": int(out.size),
                             "dtype": str(out.dtype)}, [out.tobytes()])
 
+    def _kv_export(self, header: dict, blobs: list):
+        """Fetch the parked prompt KV pages (+ last logits) for exactly
+        the given token sequence, as raw host blobs. The page pytree is
+        flattened in ``jax.tree.leaves`` order; each leaf rides as one
+        contiguous blob with ``(shape, dtype)`` metadata in the header —
+        bitwise-lossless, same rule as the §19 host-swap blobs."""
+        if self.generator is None:
+            raise ValueError("no generation engine mounted on this server")
+        if len(blobs) != 1:
+            raise ValueError(f"kv_export expects 1 blob, got {len(blobs)}")
+        tokens = np.frombuffer(blobs[0], np.int32)
+        if tokens.size != int(header["length"]):
+            raise ValueError(
+                f"token blob holds {tokens.size} tokens, header declares "
+                f"{header['length']}")
+        got = self.generator.export_prefix(tokens)
+        if got is None:
+            return {"found": False}, []
+        import jax
+
+        data, last_logits = got
+        leaves = [np.asarray(l) for l in jax.tree.leaves(data)]
+        out = {"found": True,
+               "model_version": self.generator.model_version,
+               "leaves": [[list(l.shape), str(l.dtype)] for l in leaves],
+               "has_logits": last_logits is not None}
+        payload = [np.ascontiguousarray(l).tobytes() for l in leaves]
+        if last_logits is not None:
+            ll = np.ascontiguousarray(np.asarray(last_logits))
+            out["logits_shape"] = list(ll.shape)
+            out["logits_dtype"] = str(ll.dtype)
+            payload.append(ll.tobytes())
+        return out, payload
+
+    def _kv_handoff(self, header: dict, blobs: list) -> dict:
+        """Install shipped prefill KV pages into this server's decode
+        engine. Blob 0 is the int32 token sequence; the rest are the
+        ``kv_export`` payload verbatim. The engine validates leaf count,
+        trailing shape and dtype against its own pool and refuses the
+        whole entry on any mismatch — ``ok: false`` means the caller
+        cold-prefills, never a half-installed cache entry."""
+        if self.generator is None:
+            raise ValueError("no generation engine mounted on this server")
+        meta = header.get("leaves")
+        if not isinstance(meta, list):
+            raise ValueError("kv_handoff header missing leaves metadata")
+        want = 1 + len(meta) + (1 if header.get("has_logits") else 0)
+        if len(blobs) != want:
+            raise ValueError(
+                f"kv_handoff expects {want} blobs, got {len(blobs)}")
+        tokens = np.frombuffer(blobs[0], np.int32)
+        if tokens.size != int(header["length"]):
+            raise ValueError(
+                f"token blob holds {tokens.size} tokens, header declares "
+                f"{header['length']}")
+        leaves = []
+        for (shape, dtype), raw in zip(meta, blobs[1:1 + len(meta)]):
+            arr = np.frombuffer(raw, np.dtype(dtype))
+            leaves.append(arr.reshape([int(d) for d in shape]))
+        last_logits = None
+        if header.get("has_logits"):
+            last_logits = np.frombuffer(
+                blobs[-1], np.dtype(header["logits_dtype"])).reshape(
+                    [int(d) for d in header["logits_shape"]])
+        ok = self.generator.import_prefix(tokens, leaves,
+                                          last_logits=last_logits)
+        return {"ok": bool(ok)}
+
     def _stats(self) -> dict:
         reg = telemetry.get_registry()
         if reg is None:
@@ -364,16 +465,39 @@ class ServingClient:
     """Blocking client for the serving wire: ``infer(rows) -> outputs``.
 
     One connection; callers on multiple threads serialize behind a lock
-    (same contention profile as RemoteParameterServer)."""
+    (same contention profile as RemoteParameterServer). A dropped
+    connection is retried through ``retry`` (a ``comms/retry.py``
+    :class:`RetryPolicy`, same rails remote_ps grew in PR 8): the client
+    reconnects, re-authenticates (the shared token rides every header)
+    and resends the request. Only whole requests are retried — a
+    ``generate`` that already streamed tokens raises instead, because
+    replaying it could double-emit; the fleet router layers its own
+    re-queue on top (serving/fleet.py, DESIGN.md §22). ``retry=None``
+    restores the old fail-fast behaviour."""
 
     def __init__(self, address: str, token: Optional[str] = None,
-                 timeout: float = 60.0):
+                 timeout: float = 60.0, retry=DEFAULT_RETRY):
         host, port = address.rsplit(":", 1)
         self.token = token
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=timeout)
+        self._addr = (host, int(port))
+        self._timeout = timeout
+        self._retry = retry
+        self._sock = socket.create_connection(self._addr, timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
+
+    def _reconnect(self, attempt: int) -> None:
+        """Replace the dead socket after the policy's backoff delay.
+        Caller holds ``self._lock`` and owns the retry budget."""
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        time.sleep(self._retry.delay(attempt))  # dktlint: disable=lock-blocking-call
+        self._sock = socket.create_connection(  # dktlint: disable=lock-blocking-call
+            self._addr, timeout=self._timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        telemetry.counter("serving.client.reconnects").inc()
 
     def _roundtrip(self, header: dict, blobs=()) -> Tuple[dict, list]:
         # a caller inside an active trace stitches the server's spans
@@ -384,8 +508,16 @@ class ServingClient:
         # by-design: the lock held over send+recv serializes callers on
         # the single shared connection (documented contention profile)
         with self._lock:
-            send_message(self._sock, header, blobs)  # dktlint: disable=lock-blocking-call
-            return recv_message(self._sock)  # dktlint: disable=lock-blocking-call
+            attempts = self._retry.max_retries if self._retry else 0
+            for attempt in range(attempts + 1):
+                try:
+                    send_message(self._sock, header, blobs)  # dktlint: disable=lock-blocking-call
+                    return recv_message(self._sock)  # dktlint: disable=lock-blocking-call
+                except (ConnectionError, OSError):
+                    if attempt >= attempts:
+                        raise
+                    telemetry.counter("serving.client.retries").inc()
+                    self._reconnect(attempt + 1)
 
     def infer(self, rows, timeout_ms: Optional[float] = None) -> np.ndarray:
         x = np.ascontiguousarray(np.asarray(rows))
@@ -422,17 +554,28 @@ class ServingClient:
         streamed = []
         # the lock spans the whole frame sequence: one generation owns
         # the connection until its final frame (same serialization
-        # contract as _roundtrip)
+        # contract as _roundtrip). Retries cover send + first frame only
+        # — once a token streamed, a replay could double-emit, so a
+        # mid-stream drop surfaces to the caller (the fleet router
+        # re-queues at its layer, where (cid, seq) dedup applies).
         with self._lock:
-            send_message(self._sock, header, [p.tobytes()])  # dktlint: disable=lock-blocking-call
-            while True:
-                resp, blobs = recv_message(self._sock)  # dktlint: disable=lock-blocking-call
-                if not resp.get("stream"):
+            attempts = self._retry.max_retries if self._retry else 0
+            for attempt in range(attempts + 1):
+                try:
+                    send_message(self._sock, header, [p.tobytes()])  # dktlint: disable=lock-blocking-call
+                    resp, blobs = recv_message(self._sock)  # dktlint: disable=lock-blocking-call
                     break
+                except (ConnectionError, OSError):
+                    if attempt >= attempts:
+                        raise
+                    telemetry.counter("serving.client.retries").inc()
+                    self._reconnect(attempt + 1)
+            while resp.get("stream"):
                 for t in resp["tokens"]:
                     streamed.append(int(t))
                     if on_token is not None:
                         on_token(int(t))
+                resp, blobs = recv_message(self._sock)  # dktlint: disable=lock-blocking-call
         if "error" in resp:
             raise RuntimeError(
                 f"serving ({resp.get('kind', '?')}): {resp['error']}")
@@ -470,6 +613,46 @@ class ServingClient:
     def stats(self) -> dict:
         resp, _ = self._roundtrip({"op": "stats"})
         return resp
+
+    def status(self) -> dict:
+        """The server's live health ``status`` digest (queue depth,
+        slots, model version, ...) — the router's load signal."""
+        resp, _ = self._roundtrip({"op": "status"})
+        if "error" in resp:
+            raise RuntimeError(f"serving: {resp['error']}")
+        return resp
+
+    def kv_export(self, tokens):
+        """Fetch the parked prompt KV for ``tokens`` from this replica's
+        prefix cache. Returns the raw ``(header, blobs)`` wire payload
+        (``header["found"]`` False when the cache holds no such entry) —
+        the router ships it to a decode replica verbatim via
+        :meth:`kv_handoff`, no host-side decode in between."""
+        t = np.ascontiguousarray(np.asarray(tokens, np.int32).reshape(-1))
+        resp, blobs = self._roundtrip(
+            {"op": "kv_export", "length": int(t.size)}, [t.tobytes()])
+        if "error" in resp:
+            raise RuntimeError(
+                f"serving ({resp.get('kind', '?')}): {resp['error']}")
+        return resp, blobs
+
+    def kv_handoff(self, tokens, export_header: dict,
+                   export_blobs) -> bool:
+        """Install a :meth:`kv_export` payload into this replica's
+        prefix cache. False means the replica refused the entry
+        (shape/dtype mismatch) and the caller should cold-prefill."""
+        t = np.ascontiguousarray(np.asarray(tokens, np.int32).reshape(-1))
+        header = {"op": "kv_handoff", "length": int(t.size),
+                  "leaves": export_header["leaves"],
+                  "has_logits": export_header.get("has_logits", False)}
+        if header["has_logits"]:
+            header["logits_shape"] = export_header["logits_shape"]
+            header["logits_dtype"] = export_header["logits_dtype"]
+        resp, _ = self._roundtrip(header, [t.tobytes()] + list(export_blobs))
+        if "error" in resp:
+            raise RuntimeError(
+                f"serving ({resp.get('kind', '?')}): {resp['error']}")
+        return bool(resp.get("ok"))
 
     def ping(self) -> bool:
         resp, _ = self._roundtrip({"op": "ping"})

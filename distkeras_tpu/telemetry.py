@@ -124,6 +124,20 @@ METRIC_NAMES = {
     # fault injection
     "fault.chaos": "counter",
     "fault.injected": "counter",
+    # routed serving fleet (serving/fleet.py, DESIGN.md §22)
+    "fleet.affinity.entries": "gauge",
+    "fleet.affinity.hit_rate": "gauge",
+    "fleet.affinity.hits": "counter",
+    "fleet.affinity.misses": "counter",
+    "fleet.evictions": "counter",
+    "fleet.handoff_failures": "counter",
+    "fleet.handoffs": "counter",
+    "fleet.replica.queue_depth": "gauge",
+    "fleet.replicas": "gauge",
+    "fleet.requests": "counter",
+    "fleet.requeued": "counter",
+    "fleet.sheds": "counter",
+    "fleet.version_skew": "gauge",
     # health plane
     "health.alerts.active": "gauge",
     "health.alerts.breaches": "counter",
@@ -190,6 +204,8 @@ METRIC_NAMES = {
     "serving.queue_depth": "gauge",
     "serving.rejected": "counter",
     "serving.request_latency_s": "histogram",
+    "serving.client.reconnects": "counter",
+    "serving.client.retries": "counter",
     "serving.server.auth_failures": "counter",
     "serving.server.inflight_connections": "gauge",
     "serving.server.requests": "counter",
@@ -219,9 +235,11 @@ METRIC_NAMES = {
     # with host swap, speculative decoding
     "serving.decode.prefix.bytes": "gauge",
     "serving.decode.prefix.evictions": "counter",
+    "serving.decode.prefix.exports": "counter",
     "serving.decode.prefix.full_hits": "counter",
     "serving.decode.prefix.hit_rate": "gauge",
     "serving.decode.prefix.hits": "counter",
+    "serving.decode.prefix.imports": "counter",
     "serving.decode.prefix.inserts": "counter",
     "serving.decode.prefix.misses": "counter",
     "serving.decode.paged.page_occupancy": "gauge",

@@ -187,6 +187,14 @@ def inject_chaos(site: str, action: str, after: int = 0,
       ``remote_ps.send`` (``reset`` before the bytes leave,
       ``reset_after_send`` after — the ack-dedup scenario, ``drop``
       swallows the request into a timeout, ``delay`` sleeps first).
+    - ``"fleet.kv_handoff"`` — the cross-host prefill→decode KV page
+      handoff (:meth:`FleetRouter._maybe_disaggregate`,
+      serving/fleet.py): ANY armed action models a torn/lost handoff —
+      the exported blobs never reach the decode replica. The router
+      counts a ``fleet.handoff_failures`` and the request degrades to a
+      cold prefill on the decode replica — slower, never a corrupted
+      or half-installed cache entry (same rule as ``kv.swap_in``,
+      DESIGN.md §22).
     """
     if action not in CHAOS_ACTIONS:
         raise ValueError(f"chaos action must be one of {CHAOS_ACTIONS}, "

@@ -20,7 +20,7 @@ PKG_MODULES = sorted(
 
 def test_discovery_found_the_tools():
     # the floor protects against the glob silently matching nothing
-    assert len(SCRIPTS) >= 19, SCRIPTS
+    assert len(SCRIPTS) >= 20, SCRIPTS
     assert "distkeras_tpu.benchmarks.run_config" in PKG_MODULES
     # the serving load generator (ISSUE 2) must be under the smoke glob
     assert any(os.path.basename(p) == "serving_load.py" for p in SCRIPTS)
@@ -55,6 +55,8 @@ def test_discovery_found_the_tools():
     assert any(os.path.basename(p) == "data_probe.py" for p in SCRIPTS)
     # the op-inventory roofline sweep (ISSUE 16) too
     assert any(os.path.basename(p) == "roofline_probe.py" for p in SCRIPTS)
+    # the routed-serving-fleet probe (ISSUE 17) too
+    assert any(os.path.basename(p) == "fleet_probe.py" for p in SCRIPTS)
 
 
 def test_step_probe_exposes_sweep_api():

@@ -73,12 +73,26 @@ def test_token_required_and_connection_dropped_on_mismatch(served):
             bad = ServingClient(f"127.0.0.1:{srv.port}", token=bad_token)
             with pytest.raises(RuntimeError, match="authentication"):
                 bad.ping()
-            # the server hangs up after an auth failure: the NEXT request
-            # on the same connection dies at the socket, not the app layer
-            with pytest.raises((ConnectionError, OSError)):
+            # the server hangs up after an auth failure; the retrying
+            # client (PR 17) reconnects and is refused again with the
+            # same typed error — a wrong token never turns into a
+            # silent socket death
+            with pytest.raises(RuntimeError, match="authentication"):
                 bad.ping()
+            # fail-fast clients (retry=None) keep the old contract: the
+            # NEXT request on the hung-up connection dies at the socket
+            raw = ServingClient(f"127.0.0.1:{srv.port}", token=bad_token,
+                                retry=None)
+            with pytest.raises(RuntimeError, match="authentication"):
+                raw.ping()
+            with pytest.raises((ConnectionError, OSError)):
+                raw.ping()
+            raw.close()
             bad.close()
-        assert telemetry.counter("serving.server.auth_failures").value == 2
+        # three refused requests per bad token: two from the retrying
+        # client (each reconnect re-presents the bad token), one from
+        # the fail-fast client's first ping
+        assert telemetry.counter("serving.server.auth_failures").value == 6
     finally:
         srv.stop()
         eng.shutdown()
