@@ -1,23 +1,17 @@
 """Ablation for the fused scaled-int8 matmul-dequant Pallas kernel.
 
-The gate on ``ops/pallas/int8_matmul.USE_FUSED_INT8_MATMUL`` (default
-off, per the groupnorm precedent — a custom call is a fusion fence to
-XLA): the kernel earns its default only by beating the pure-XLA int8
-fallback HERE, on the target TPU generation. Three variants per shape:
-
-- ``bf16``:    plain bf16 matmul — the no-quantization baseline the int8
-               policy's 2x-rate claim is measured against,
-- ``xla-int8``: int8 x int8 -> int32 dot + dequant, XLA's own fusion
-               (what precision.py uses while the kernel is off),
-- ``pallas``:  the fused kernel (``interpret=True`` off-TPU, which
-               measures nothing — rows are labeled so a CPU run can't be
-               mistaken for evidence).
+Thin alias over the shared kernel-ablation harness
+(``benchmarks/kernel_ablate.py``, which generalized this file's
+bf16-vs-xla-vs-pallas protocol to the whole kernel tier) — kept so the
+documented command line keeps working. The gate itself is unchanged:
+``ops/pallas/int8_matmul.USE_FUSED_INT8_MATMUL`` stays default-off until
+the kernel beats the pure-XLA int8 fallback HERE, on the target TPU
+generation; off-TPU runs get an honest ``no-tpu-evidence`` verdict.
 
 Usage: python benchmarks/int8_matmul_ablate.py [--sizes M,K,N[;M,K,N...]]
        [--iters N]
-One JSON line per (variant, shape) with the median of ``--iters`` timed
-calls (fetch-synced); plus a ``verdict`` line comparing pallas vs
-xla-int8 per shape. Flip the default only on a TPU-backed win.
+Equivalent to: python benchmarks/kernel_ablate.py --kernel int8_matmul
+               [--shapes ...] [--iters N]
 """
 
 from __future__ import annotations
@@ -26,9 +20,6 @@ import argparse
 import json
 import os
 import sys
-import time
-
-import numpy as np
 
 try:
     import distkeras_tpu  # noqa: F401  (pip-installed)
@@ -36,55 +27,17 @@ except ImportError:  # running from a source checkout: use the repo root
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
 
+# sibling script import: benchmarks/ is on sys.path both under
+# `python benchmarks/x.py` and the file-spec import smoke test
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import kernel_ablate  # noqa: E402
+
 DEFAULT_SIZES = ((512, 512, 512), (1024, 1024, 1024), (2048, 2048, 2048))
 
 
-def _time_fn(fn, iters: int) -> float:
-    """Median wall time of ``iters`` calls, fetch = completion barrier."""
-    np.asarray(fn())  # compile + settle
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        np.asarray(fn())
-        times.append(time.perf_counter() - t0)
-    return sorted(times)[len(times) // 2]
-
-
 def ablate(sizes=DEFAULT_SIZES, iters: int = 5):
-    """Yield one result row per (variant, shape) + a verdict per shape."""
-    import jax
-    import jax.numpy as jnp
-
-    from distkeras_tpu.ops.pallas import int8_matmul as k
-
-    on_tpu = k._on_tpu()
-    for (m, kk, n), (qx, qw, sxw) in zip(
-            sizes, k.reference_rows(sizes=sizes)):
-        qxd, qwd = jnp.asarray(qx), jnp.asarray(qw)
-        bx = (qxd.astype(jnp.float32) * sxw).astype(jnp.bfloat16)
-        bw = qwd.astype(jnp.bfloat16)
-        flops = 2 * m * kk * n
-        base = {"m": m, "k": kk, "n": n, "backend":
-                jax.devices()[0].platform}
-        dts = {}
-
-        bf16_mm = jax.jit(lambda a, b: (a @ b).astype(jnp.float32))
-        dts["bf16"] = _time_fn(lambda: bf16_mm(bx, bw), iters)
-        xla = jax.jit(k.xla_int8_matmul_dequant)
-        dts["xla-int8"] = _time_fn(lambda: xla(qxd, qwd, sxw), iters)
-        if k.fits(qx.shape, qw.shape):
-            dts["pallas" if on_tpu else "pallas-interpret"] = _time_fn(
-                lambda: k.int8_matmul_dequant(qxd, qwd, sxw,
-                                              interpret=not on_tpu), iters)
-        for variant, dt in dts.items():
-            yield dict(base, variant=variant, sec=round(dt, 6),
-                       tflops=round(flops / dt / 1e12, 3))
-        pallas_dt = dts.get("pallas")
-        yield dict(base, verdict=(
-            "pallas-wins" if pallas_dt and pallas_dt < dts["xla-int8"]
-            else "xla-wins" if pallas_dt
-            else "no-tpu-evidence (interpret timing is not evidence; "
-                 "keep USE_FUSED_INT8_MATMUL off)"))
+    """Original entry point, now routed through the shared harness."""
+    return kernel_ablate.ablate("int8_matmul", shapes=sizes, iters=iters)
 
 
 def main():
@@ -94,10 +47,7 @@ def main():
                          "(default 512^3;1024^3;2048^3)")
     ap.add_argument("--iters", type=int, default=5)
     args = ap.parse_args()
-    sizes = DEFAULT_SIZES
-    if args.sizes:
-        sizes = tuple(tuple(int(v) for v in s.split(","))
-                      for s in args.sizes.split(";"))
+    sizes = kernel_ablate.parse_shapes(args.sizes) or DEFAULT_SIZES
     for row in ablate(sizes=sizes, iters=args.iters):
         print(json.dumps(row), flush=True)
 

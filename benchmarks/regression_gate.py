@@ -39,7 +39,11 @@ roofline (``--check roofline``)
     time GREW by more than ``--op-budget`` (absolute) fails — so a
     future kernel PR must show its target op shrinking, not just the
     wall clock moving. Ops present in only one file don't vote (XLA is
-    free to rename fusions between releases).
+    free to rename fusions between releases). A file carrying an
+    in-file A/B (``kind="op_baseline"`` rows, attribution.py
+    --attention) additionally gets the ``profile.op.attention_share``
+    verdict: the pallas-attention group's summed share must SHRINK
+    from the XLA baseline leg to the kernel leg of the SAME file.
 
 decode (``--check decode``)
     Learns the serving-decode ladder from the committed
@@ -269,7 +273,12 @@ def load_roofline_history(repo_dir: str = REPO) -> List[Tuple[int, dict]]:
     """``[(pr_n, doc), ...]`` sorted by PR from the committed
     ``results/pr*_attribution_ops.jsonl`` files. ``doc`` carries
     ``coverage``/``overhead_frac`` plus ``shares`` ({op: share}) and
-    ``bounds`` ({op: boundedness}) from the top-k op rows."""
+    ``bounds`` ({op: boundedness}) from the top-k op rows. A file that
+    also carries ``kind="op_baseline"`` rows (attribution --attention,
+    PR 18) is a within-file A/B: the summed share of its
+    ``pallas-attention``-tagged rows lands in
+    ``attention_share_baseline`` (baseline leg) and ``attention_share``
+    (variant leg) for ``judge_roofline``'s shrink verdict."""
     out = []
     pattern = os.path.join(repo_dir, "benchmarks", "results",
                            "pr*_attribution_ops.jsonl")
@@ -292,6 +301,15 @@ def load_roofline_history(repo_dir: str = REPO) -> List[Tuple[int, dict]]:
                     elif row.get("kind") == "op":
                         doc["shares"][row["op"]] = row.get("share", 0.0)
                         doc["bounds"][row["op"]] = row.get("bound", "?")
+                        if row.get("fix") == "pallas-attention":
+                            doc["attention_share"] = (
+                                doc.get("attention_share", 0.0)
+                                + (row.get("share") or 0.0))
+                    elif row.get("kind") == "op_baseline":
+                        if row.get("fix") == "pallas-attention":
+                            doc["attention_share_baseline"] = (
+                                doc.get("attention_share_baseline", 0.0)
+                                + (row.get("share") or 0.0))
         except (OSError, ValueError):
             continue
         if doc["shares"] or "coverage" in doc:
@@ -334,6 +352,26 @@ def judge_roofline(history: List[Tuple[int, dict]],
             "note": (f"pr{n_new:02d} default-path overhead "
                      f"{over:+.2%} (ceiling {overhead_ceil:.0%}, "
                      f"capture stays opt-in)")})
+    att_base = newest.get("attention_share_baseline")
+    att_new = newest.get("attention_share")
+    if att_base is not None:
+        # within-file A/B (PR 18): the attention group's share of modeled
+        # step time must SHRINK when the fused kernel replaces the XLA
+        # path — judged on the same file because the kernel substitution
+        # and its XLA baseline were derived from one compiled executable
+        status = ("pass" if att_new is not None and att_new < att_base
+                  else "fail")
+        verdicts.append({
+            "kind": "verdict", "check": "roofline",
+            "metric": "profile.op.attention_share", "release": n_new,
+            "baseline": round(att_base, 4),
+            "observed": None if att_new is None else round(att_new, 4),
+            "status": status,
+            "note": (f"pr{n_new:02d} attention group share "
+                     f"{att_base:.1%} (XLA baseline) -> "
+                     + (f"{att_new:.1%} (flash kernel-modeled); must "
+                        f"shrink" if att_new is not None
+                        else "no variant rows"))})
     if len(history) >= 2:
         n_base, base = history[-2]
         shared = sorted(set(base["shares"]) & set(newest["shares"]))

@@ -27,7 +27,12 @@ With ``--buckets`` the sweep instead probes gradient-bucket collective
 overlap: one row per (precision, bucket_bytes) timing the sync-DP epoch
 step over all local devices, where ``none`` is the GSPMD baseline
 (implicit grad all-reduce) and each byte size is the explicit shard_map
-step with per-bucket psums (parallel/collectives.py).
+step with per-bucket psums (parallel/collectives.py). Adding
+``--overlap`` turns that into the JOINT grid (ROADMAP item 1(c)): every
+bucket size crossed with the async wire leg serialized and overlapped
+(:func:`joint_probe`), measuring whether the two schedules compose.
+``--attention xla|flash`` pins the attention kernel switch for the
+attention families (gpt/bert/vit; comma-axis in sweep mode).
 
 JSONL row schema (absent keys were not measurable on this backend; a
 config that raises emits an ``error`` row instead and the process exits
@@ -42,6 +47,10 @@ nonzero — OOMs are REPORTED, never crashes):
 - --find-max-batch rows: ``largest_batch``, ``search_limit``
 - --buckets rows: ``mode`` ("gspmd" | "bucketed"), ``bucket_bytes``
   (null for gspmd), ``num_workers``, ``precision``
+- --buckets --overlap rows: plus ``comms_overlap``, ``epoch_s``,
+  ``comms_s``, ``total_s``, ``composition`` (total / (epoch + comms);
+  1.0 = serialized, lower = the wire leg hid behind the epoch)
+- rows probing a pinned attention kernel carry ``attention``
 - error rows: the swept axes + ``error`` ("ExcType: message")
 """
 
@@ -63,17 +72,24 @@ except ImportError:  # running from a source checkout: use the repo root
 
 
 def build_family(name: str, batch: int, remat: str = "none",
-                 precision: str = None) -> tuple:
+                 precision: str = None, attention: str = None) -> tuple:
     """(model, loss, x, y) for one probe family; ``remat`` is threaded to
     the model's rematerialization field (models/remat.py) where the family
     has one (cnn has no block structure to checkpoint), ``precision`` to
-    its mixed-precision field (distkeras_tpu/precision.py)."""
+    its mixed-precision field (distkeras_tpu/precision.py), ``attention``
+    ("xla" | "flash") to its attention kernel switch (ops/attention.py)
+    where the family has attention at all."""
     import jax.numpy as jnp
 
+    if attention not in (None, "xla", "flash"):
+        raise ValueError(f"attention={attention!r}; expected xla|flash")
+    if attention is not None and name in ("resnet", "cnn"):
+        raise ValueError(f"{name} has no attention op to switch")
     if name == "vit":
         from distkeras_tpu.models import vit_base
 
-        model = vit_base(remat=remat, precision=precision)
+        model = vit_base(remat=remat, precision=precision,
+                         attention=attention)
         loss = "categorical_crossentropy"
         rng = np.random.default_rng(0)
         x = rng.integers(0, 256, (batch, 224, 224, 3), dtype=np.uint8)
@@ -89,7 +105,8 @@ def build_family(name: str, batch: int, remat: str = "none",
     elif name == "bert":
         from distkeras_tpu.models import bert_base
 
-        model, loss = bert_base(remat=remat, precision=precision), "masked_lm"
+        model, loss = (bert_base(remat=remat, precision=precision,
+                                 attention=attention), "masked_lm")
         rng = np.random.default_rng(0)
         x = rng.integers(1, model.vocab_size, (batch, 128)).astype(np.int16)
         y = np.where(rng.random((batch, 128)) < 0.15, x, -1).astype(np.int16)
@@ -106,14 +123,17 @@ def build_family(name: str, batch: int, remat: str = "none",
         x = rng.standard_normal((batch, 32, 32, 3)).astype(np.float32)
         y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
     elif name == "gpt":
-        # long-context chip-side artifact: GPT-2-small shapes at seq 2048
-        # on the fused pallas flash path (single-chip complement of the
-        # cross-chip ring attention)
+        # long-context chip-side artifact: GPT-2-small shapes at seq 2048.
+        # Default stays the fused flash path (single-chip complement of
+        # the cross-chip ring attention); --attention xla pins the plain
+        # causal path so the two kernels are A/B-able at the step level
         from distkeras_tpu.models.gpt import CausalLM
 
+        gpt_attn = {"xla": "full", "flash": "flash",
+                    None: "flash"}[attention]
         model = CausalLM(vocab_size=50304, max_len=2048, num_layers=12,
                          num_heads=12, width=768, mlp_dim=3072,
-                         attention="flash", remat=remat,
+                         attention=gpt_attn, remat=remat,
                          precision=precision)
         loss = "masked_lm"
         rng = np.random.default_rng(0)
@@ -125,14 +145,15 @@ def build_family(name: str, batch: int, remat: str = "none",
     return model, loss, x, y
 
 
-def probe(name: str, batch: int, steps: int = 8) -> dict:
+def probe(name: str, batch: int, steps: int = 8,
+          attention: str = None) -> dict:
     import jax
     import jax.numpy as jnp
     import optax
 
     from distkeras_tpu import engine, observability
 
-    model, loss, x, y = build_family(name, batch)
+    model, loss, x, y = build_family(name, batch, attention=attention)
     tx = optax.adamw(1e-3)
     grad_fn = engine.make_grad_fn(model, loss)
     xd, yd = jnp.asarray(x), jnp.asarray(y)
@@ -165,6 +186,8 @@ def probe(name: str, batch: int, steps: int = 8) -> dict:
     dt = sorted(times)[1]
     out = {"model": name, "batch": batch, "steps_per_call": steps,
            "samples_per_sec": round(batch * steps / dt, 1)}
+    if attention is not None:
+        out["attention"] = attention
     peak = observability.device_peak_flops()
     if peak:
         out["mfu"] = round(flops / dt / peak, 4)
@@ -172,7 +195,7 @@ def probe(name: str, batch: int, steps: int = 8) -> dict:
 
 
 def phase_probe(name: str, batch: int, steps: int = 8,
-                iters: int = 3) -> dict:
+                iters: int = 3, attention: str = None) -> dict:
     """Step-time decomposition of the bare-step window (DESIGN.md §15).
 
     Times each window's phases separately — ``h2d`` (host batch onto the
@@ -193,7 +216,7 @@ def phase_probe(name: str, batch: int, steps: int = 8,
 
     if telemetry.get_registry() is None:
         telemetry.install(telemetry.MetricsRegistry())
-    model, loss, x, y = build_family(name, batch)
+    model, loss, x, y = build_family(name, batch, attention=attention)
     tx = optax.adamw(1e-3)
     grad_fn = engine.make_grad_fn(model, loss)
     xd, yd = jnp.asarray(x), jnp.asarray(y)
@@ -252,6 +275,8 @@ def phase_probe(name: str, batch: int, steps: int = 8,
     out = {"model": name, "batch": batch, "steps_per_call": steps,
            "window_s": round(window, 6),
            "samples_per_sec": round(batch * steps / window, 1)}
+    if attention is not None:
+        out["attention"] = attention
     for ph in ("h2d", "compute", "collective"):
         m = med(phases[ph])
         if m is not None:
@@ -281,7 +306,7 @@ def _is_oom(e: BaseException) -> bool:
 
 def sweep_probe(name: str, batch: int, steps: int, accum_steps: int,
                 remat: str, compile_only: bool = False,
-                precision: str = None) -> dict:
+                precision: str = None, attention: str = None) -> dict:
     """One (model, accum, remat, precision) cell of the sweep matrix.
 
     Reports samples/s (fetch-synced, like :func:`probe`), XLA's static
@@ -308,7 +333,8 @@ def sweep_probe(name: str, batch: int, steps: int, accum_steps: int,
         raise ValueError(f"accum_steps={accum_steps} must divide "
                          f"batch={batch}")
     model, loss, x, y = build_family(name, batch, remat=remat,
-                                     precision=precision)
+                                     precision=precision,
+                                     attention=attention)
     policy = precision_lib.get_policy(precision)
     tx = optax.adamw(1e-3)
     if policy is not None and policy.loss_scale != 1.0:
@@ -339,6 +365,8 @@ def sweep_probe(name: str, batch: int, steps: int, accum_steps: int,
     out = {"model": name, "batch": batch, "accum_steps": accum_steps,
            "remat": remat, "precision": precision,
            "mfu_dtype": mfu_dtype, "steps_per_call": steps}
+    if attention is not None:
+        out["attention"] = attention
     compiled = run.lower(state.params, state.opt_state, xd, yd).compile()
     mem = observability.compiled_memory_bytes(compiled)
     if mem:
@@ -448,6 +476,109 @@ def overlap_probe(name: str, batch: int, steps: int,
             "samples_per_sec": round(batch * steps / dt, 1)}
 
 
+def joint_probe(name: str, batch: int, steps: int, bucket_bytes,
+                comms_overlap: bool, precision: str = None,
+                attention: str = None, comms_codec: str = "int8") -> dict:
+    """One cell of the joint ``bucket_bytes x comms_overlap`` grid — the
+    co-scheduling sweep ROADMAP item 1(c) calls for: do the in-step
+    collective schedule (PR 6's gradient buckets) and the cross-step wire
+    work (PR 3's overlapped commit/pull) COMPOSE, or do they fight for
+    the same host/interconnect resources?
+
+    The epoch leg is :func:`overlap_probe`'s sync-DP step at the given
+    bucket size. The comms leg is the async runner's per-round wire work
+    at this model's gradient size — an int8 encode + decode of every
+    grad-shaped leaf (what host_async's comms thread does between
+    windows). ``comms_overlap=False`` runs the legs back-to-back (the
+    serialized schedule), ``True`` runs the comms leg in a thread while
+    the epoch computes (PR 3's schedule). The row reports both legs'
+    seconds plus ``composition`` = total / (epoch + comms): 1.0 means
+    fully serialized, ~max(e,c)/(e+c) means fully hidden. On a CPU host
+    both legs share the same cores, so composition ~1.0 is the honest
+    expected result — the grid exists to run on a TPU host where the
+    epoch leg is off-CPU (results/README.md provenance rule).
+    """
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distkeras_tpu import comms, engine
+    from distkeras_tpu import precision as precision_lib
+    from distkeras_tpu.parallel import mesh as mesh_lib
+    from distkeras_tpu.parallel import tensor
+
+    mesh = mesh_lib.make_mesh()
+    num_workers = mesh.shape[mesh_lib.WORKER_AXIS]
+    if batch % num_workers:
+        raise ValueError(f"batch={batch} must divide over the "
+                         f"{num_workers} local devices")
+    model, loss, x, y = build_family(name, batch, precision=precision,
+                                     attention=attention)
+    policy = precision_lib.get_policy(precision)
+    tx = optax.adamw(1e-3)
+    if policy is not None and policy.loss_scale != 1.0:
+        tx = precision_lib.overflow_guard(tx, policy)
+    epoch_fn, place_state, place_data = tensor.build_pjit_epoch_fn(
+        model, loss, tx, mesh, precision=precision,
+        bucket_bytes=bucket_bytes)
+    xd = jnp.asarray(x)
+    state = place_state(engine.create_train_state(
+        model, jax.random.key(0), {"features": xd}, tx))
+    data = place_data({
+        "features": np.broadcast_to(x[None], (steps,) + x.shape),
+        "labels": np.broadcast_to(y[None], (steps,) + y.shape)})
+
+    codec = comms.get_codec(comms_codec)
+    leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(
+        jax.tree.map(np.asarray, jax.device_get(state.params)))]
+    specs = [(l.shape, l.dtype) for l in leaves]
+
+    def comms_leg():
+        t0 = time.perf_counter()
+        blobs = [codec.encode(l, kind="commit") for l in leaves]
+        for b, (s, d) in zip(blobs, specs):
+            codec.decode(bytes(b), s, d, kind="commit")
+        return time.perf_counter() - t0
+
+    state, ms = epoch_fn(state, data, 0)
+    float(np.asarray(ms["loss"]).sum())  # compile + settle
+    comms_leg()                          # warm the codec path too
+    totals, epochs, comm_ts = [], [], []
+    for _ in range(3):
+        comms_s = [None]
+        t0 = time.perf_counter()
+        if comms_overlap:
+            th = threading.Thread(
+                target=lambda: comms_s.__setitem__(0, comms_leg()))
+            th.start()
+        state, ms = epoch_fn(state, data, 0)
+        float(np.asarray(ms["loss"]).sum())
+        t_epoch = time.perf_counter() - t0
+        if comms_overlap:
+            th.join()
+        else:
+            comms_s[0] = comms_leg()
+        totals.append(time.perf_counter() - t0)
+        epochs.append(t_epoch)
+        comm_ts.append(comms_s[0])
+    med = lambda v: sorted(v)[len(v) // 2]
+    total, epoch_s, comms_t = med(totals), med(epochs), med(comm_ts)
+    out = {"model": name, "batch": batch, "steps_per_call": steps,
+           "mode": "gspmd" if bucket_bytes is None else "bucketed",
+           "bucket_bytes": bucket_bytes, "comms_overlap": comms_overlap,
+           "comms_codec": comms_codec, "num_workers": num_workers,
+           "precision": precision,
+           "epoch_s": round(epoch_s, 6), "comms_s": round(comms_t, 6),
+           "total_s": round(total, 6),
+           "composition": round(total / (epoch_s + comms_t), 4),
+           "samples_per_sec": round(batch * steps / total, 1)}
+    if attention is not None:
+        out["attention"] = attention
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("which", nargs="?", default="all",
@@ -470,6 +601,15 @@ def main():
                     help="sweep mode: comma-separated grad-bucket byte "
                          "sizes ('none' = GSPMD baseline); replaces the "
                          "accum x remat matrix with the overlap sweep")
+    ap.add_argument("--overlap", action="store_true",
+                    help="with --buckets: run the joint bucket_bytes x "
+                         "comms_overlap grid (ROADMAP item 1(c)) — each "
+                         "bucket size timed with the async wire leg "
+                         "serialized AND overlapped")
+    ap.add_argument("--attention", default=None,
+                    help="attention kernel axis (xla|flash, "
+                         "comma-separated in sweep mode) for the "
+                         "attention families (gpt/bert/vit)")
     ap.add_argument("--find-max-batch", action="store_true",
                     help="sweep mode: also run the doubling largest-batch "
                          "search per config (accelerator-backed runs)")
@@ -487,44 +627,60 @@ def main():
         if args.steps is not None:
             cfg["steps"] = args.steps
         precisions = parse_axis(args.precision)
+        attentions = parse_axis(args.attention) if args.attention else [None]
         failed = False
         if args.buckets is not None:
             buckets = [None if b is None else int(b)
                        for b in parse_axis(args.buckets)]
+            overlaps = [False, True] if args.overlap else [None]
             for prec in precisions:
                 for bucket in buckets:
-                    try:
-                        print(json.dumps(overlap_probe(
-                            args.model, cfg["batch"], cfg["steps"],
-                            bucket, precision=prec)), flush=True)
-                    except Exception as e:
-                        failed = True
-                        print(json.dumps(
-                            {"model": args.model, "bucket_bytes": bucket,
-                             "precision": prec,
-                             "error": f"{type(e).__name__}: {e}"}),
-                            flush=True)
+                    for over in overlaps:
+                        try:
+                            if over is None:
+                                row = overlap_probe(
+                                    args.model, cfg["batch"], cfg["steps"],
+                                    bucket, precision=prec)
+                            else:
+                                row = joint_probe(
+                                    args.model, cfg["batch"], cfg["steps"],
+                                    bucket, comms_overlap=over,
+                                    precision=prec,
+                                    attention=attentions[0])
+                            print(json.dumps(row), flush=True)
+                        except Exception as e:
+                            failed = True
+                            print(json.dumps(
+                                {"model": args.model,
+                                 "bucket_bytes": bucket,
+                                 "comms_overlap": over, "precision": prec,
+                                 "error": f"{type(e).__name__}: {e}"}),
+                                flush=True)
             sys.exit(1 if failed else 0)
         accums = [int(a) for a in args.accum.split(",")]
         remats = [r.strip() for r in args.remat.split(",")]
         for remat in remats:
             for accum in accums:
                 for prec in precisions:
-                    try:
-                        print(json.dumps(sweep_probe(
-                            args.model, cfg["batch"], cfg["steps"], accum,
-                            remat, precision=prec)), flush=True)
-                        if args.find_max_batch:
-                            print(json.dumps(largest_batch(
-                                args.model, cfg["steps"], accum, remat,
-                                start=cfg["batch"])), flush=True)
-                    except Exception as e:
-                        failed = True
-                        print(json.dumps(
-                            {"model": args.model, "accum_steps": accum,
-                             "remat": remat, "precision": prec,
-                             "error": f"{type(e).__name__}: {e}"}),
-                            flush=True)
+                    for attn in attentions:
+                        try:
+                            print(json.dumps(sweep_probe(
+                                args.model, cfg["batch"], cfg["steps"],
+                                accum, remat, precision=prec,
+                                attention=attn)), flush=True)
+                            if args.find_max_batch:
+                                print(json.dumps(largest_batch(
+                                    args.model, cfg["steps"], accum,
+                                    remat, start=cfg["batch"])),
+                                    flush=True)
+                        except Exception as e:
+                            failed = True
+                            print(json.dumps(
+                                {"model": args.model, "accum_steps": accum,
+                                 "remat": remat, "precision": prec,
+                                 "attention": attn,
+                                 "error": f"{type(e).__name__}: {e}"}),
+                                flush=True)
         sys.exit(1 if failed else 0)
     names = list(CANONICAL) if args.which == "all" else [args.which]
     for name in names:
@@ -535,7 +691,8 @@ def main():
             cfg["steps"] = args.steps
         try:
             fn = phase_probe if args.phases else probe
-            print(json.dumps(fn(name, cfg["batch"], steps=cfg["steps"])))
+            print(json.dumps(fn(name, cfg["batch"], steps=cfg["steps"],
+                                attention=args.attention)))
         except Exception as e:
             print(json.dumps({"model": name,
                               "error": f"{type(e).__name__}: {e}"}))

@@ -54,6 +54,8 @@ _CONSUMER_PATHS = (
     "benchmarks/data_probe.py",
     "benchmarks/roofline_probe.py",
     "benchmarks/fleet_probe.py",
+    "benchmarks/kernel_ablate.py",
+    "benchmarks/step_probe.py",
     "distkeras_tpu/profiling/cost_model.py",
     "distkeras_tpu/profiling/roofline.py",
     "distkeras_tpu/profiling/capture.py",

@@ -39,6 +39,10 @@ class BertMLM(nn.Module):
     #: mixed-precision policy (distkeras_tpu/precision.py); f32 MLM head
     #: stays f32
     precision: Optional[str] = None
+    #: "xla" | "flash" — attention kernel dispatch (ops/attention.py);
+    #: note the padding mask forces the XLA path per-call until the
+    #: fused kernel learns key-side masks
+    attention: Optional[str] = None
 
     @nn.compact
     def __call__(self, input_ids, train: bool = False, segment_ids=None):
@@ -60,7 +64,7 @@ class BertMLM(nn.Module):
         mask = ids != self.pad_id  # [b, seq] key-side padding mask
         x = Encoder(self.num_layers, self.num_heads, self.mlp_dim,
                     self.dropout_rate, self.dtype, remat=self.remat,
-                    precision=self.precision,
+                    precision=self.precision, attention=self.attention,
                     name="encoder")(x, mask=mask, train=train)
 
         # MLM head: transform + tied-style output projection

@@ -91,25 +91,62 @@ class CausalSelfAttention(nn.Module):
             rows = jnp.arange(b)[:, None]
             pos = cache_index[:, None] + jnp.arange(t)[None, :]  # [b, t]
             if page_table is not None:
-                # paged layout: gather each row's pages into the SAME
-                # dense [b, max_len, heads, head_dim] view the
-                # rectangular path attends over, so the attention below
-                # is shape- and value-identical (bitwise parity)
+                from distkeras_tpu.ops.pallas import flash_attention as _fa
+
                 ps = cache["k"].shape[1]
                 pmax = page_table.shape[1]
                 max_len = pmax * ps
-                gather = lambda pages: pages[page_table].reshape(
-                    b, max_len, self.num_heads, head_dim)
-                k_cache = gather(cache["k"]).at[rows, pos].set(
-                    k, mode="drop")
-                v_cache = gather(cache["v"]).at[rows, pos].set(
-                    v, mode="drop")
-            else:
-                # mode="drop": a ghost position past max_len-1 (the decode
-                # step's gemm-path padding, DESIGN.md §14) must not clamp
-                # onto the last real cell
-                k_cache = cache["k"].at[rows, pos].set(k, mode="drop")
-                v_cache = cache["v"].at[rows, pos].set(v, mode="drop")
+                # scatter the in-call block to its PHYSICAL page cells
+                # FIRST. Ghost/overflow positions (>= max_len) and
+                # positions whose table entry is unmapped route to the
+                # scratch page (the pool keeps unmapped entries pointing
+                # there), so no live page is ever perturbed by padding.
+                # Scatter-before-attend is value-identical to the old
+                # gather-then-overlay order: every view position the
+                # scatter changes is either an in-call position (where
+                # the overlay put the same k/v value) or masked to
+                # exact-zero softmax weight, so attention output is
+                # bitwise unchanged — and it lets the paged kernel read
+                # pages[page_table] directly.
+                scratch_page = cache["k"].shape[0] - 1
+                page_idx = jnp.clip(pos // ps, 0, pmax - 1)
+                phys = jnp.take_along_axis(page_table, page_idx, axis=1)
+                phys = jnp.where(pos < max_len, phys, scratch_page)
+                off = jnp.where(pos < max_len, pos % ps, 0)
+                new_cache = {"k": cache["k"].at[phys, off].set(k),
+                             "v": cache["v"].at[phys, off].set(v)}
+                if _fa.paged_dispatch(q.shape, cache["k"].shape,
+                                      page_table.shape):
+                    # fused paged kernel (DESIGN.md §23): the page DMAs
+                    # are indexed by page_table INSIDE the kernel grid —
+                    # the dense [b, max_len] HBM view below is never
+                    # materialized (DESIGN.md §19's honest limit)
+                    out = _fa.paged_flash_attention(
+                        q, new_cache["k"], new_cache["v"], page_table,
+                        cache_index, interpret=_fa.PAGED_INTERPRET)
+                else:
+                    # XLA fallback: gather each row's pages into the
+                    # SAME dense [b, max_len, heads, head_dim] view the
+                    # rectangular path attends over (shape- and
+                    # value-identical — bitwise parity)
+                    gather = lambda pages: pages[page_table].reshape(
+                        b, max_len, self.num_heads, head_dim)
+                    k_cache = gather(new_cache["k"])
+                    v_cache = gather(new_cache["v"])
+                    key_pos = jnp.arange(max_len)
+                    mask = (key_pos[None, None, None, :]
+                            <= pos[:, None, :, None])
+                    out = dot_product_attention(q, k_cache, v_cache,
+                                                mask=mask)
+                out = out.reshape(out.shape[:2] + (width,))
+                out = nn.Dense(width, dtype=dtype, name="out",
+                               **dense_kw)(out)
+                return out, new_cache
+            # mode="drop": a ghost position past max_len-1 (the decode
+            # step's gemm-path padding, DESIGN.md §14) must not clamp
+            # onto the last real cell
+            k_cache = cache["k"].at[rows, pos].set(k, mode="drop")
+            v_cache = cache["v"].at[rows, pos].set(v, mode="drop")
             # causal across history + block: key p visible to query j iff
             # p <= cache_index + j; masked keys get exact-zero softmax
             # weight (MASK_VALUE underflows), so the fixed-length
@@ -119,29 +156,17 @@ class CausalSelfAttention(nn.Module):
             out = dot_product_attention(q, k_cache, v_cache, mask=mask)
             out = out.reshape(out.shape[:2] + (width,))
             out = nn.Dense(width, dtype=dtype, name="out", **dense_kw)(out)
-            if page_table is not None:
-                # scatter the in-call block to its PHYSICAL page cells.
-                # Ghost/overflow positions (>= max_len) and positions whose
-                # table entry is unmapped route to the scratch page (the
-                # pool keeps unmapped entries pointing there), so no live
-                # page is ever perturbed by padding.
-                scratch_page = cache["k"].shape[0] - 1
-                page_idx = jnp.clip(pos // ps, 0, pmax - 1)
-                phys = jnp.take_along_axis(page_table, page_idx, axis=1)
-                phys = jnp.where(pos < max_len, phys, scratch_page)
-                off = jnp.where(pos < max_len, pos % ps, 0)
-                new_cache = {"k": cache["k"].at[phys, off].set(k),
-                             "v": cache["v"].at[phys, off].set(v)}
-            else:
-                new_cache = {"k": k_cache, "v": v_cache}
-            return out, new_cache
+            return out, {"k": k_cache, "v": v_cache}
         if self.attention == "ring":
             out = ring_attention(q, k, v, axis_name=self.axis_name,
                                  causal=True)
         elif self.attention == "flash":
-            from distkeras_tpu.ops.attention import flash_attention_causal
+            # resolve()-style dispatch (ops/attention.py): in-repo fused
+            # kernel when enabled+fits, else upstream pallas on TPU,
+            # else the XLA path — preserves this field's old semantics
+            from distkeras_tpu.ops.attention import apply_attention
 
-            out = flash_attention_causal(q, k, v)
+            out = apply_attention(q, k, v, causal=True, attention="flash")
         elif self.attention == "full":
             out = dot_product_attention(q, k, v, causal=True)
         else:

@@ -97,6 +97,8 @@ class MoEEncoderBlock(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     aux_loss_weight: float = 0.01
     precision: Optional[str] = None
+    #: "xla" | "flash" — attention kernel dispatch (ops/attention.py)
+    attention: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -104,7 +106,8 @@ class MoEEncoderBlock(nn.Module):
 
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(self.dtype)
         y = MultiHeadAttention(self.num_heads, dtype=self.dtype,
-                               precision=self.precision, name="attn")(y)
+                               precision=self.precision,
+                               attention=self.attention, name="attn")(y)
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
         y = SwitchMoE(self.num_experts, self.mlp_dim, self.capacity_factor,
@@ -138,6 +141,8 @@ class MoEClassifier(nn.Module):
     #: mixed-precision policy (distkeras_tpu/precision.py); router and f32
     #: head stay f32
     precision: Optional[str] = None
+    #: "xla" | "flash" — attention kernel dispatch (ops/attention.py)
+    attention: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -150,7 +155,8 @@ class MoEClassifier(nn.Module):
                 num_heads=self.num_heads, num_experts=self.num_experts,
                 mlp_dim=self.mlp_dim, capacity_factor=self.capacity_factor,
                 dtype=self.dtype, aux_loss_weight=self.aux_loss_weight,
-                precision=self.precision, name=f"block{i}")(x, train)
+                precision=self.precision, attention=self.attention,
+                name=f"block{i}")(x, train)
         x = jnp.mean(x.astype(jnp.float32), axis=1)  # pool over tokens
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
 

@@ -42,6 +42,8 @@ class EncoderBlock(nn.Module):
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.bfloat16
     precision: Optional[str] = None
+    #: "xla" | "flash" — attention kernel dispatch (ops/attention.py)
+    attention: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask: Optional[jax.Array] = None,
@@ -49,7 +51,8 @@ class EncoderBlock(nn.Module):
         dtype = precision_lib.resolve(self.precision, self.dtype)[0]
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(dtype)
         y = MultiHeadAttention(self.num_heads, dtype=self.dtype,
-                               precision=self.precision, name="attn")(
+                               precision=self.precision,
+                               attention=self.attention, name="attn")(
                                    y, mask=mask)
         if self.dropout_rate > 0.0:
             y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
@@ -77,6 +80,8 @@ class Encoder(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     remat: str = "none"
     precision: Optional[str] = None
+    #: "xla" | "flash" — attention kernel dispatch (ops/attention.py)
+    attention: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask: Optional[jax.Array] = None,
@@ -85,5 +90,6 @@ class Encoder(nn.Module):
         for i in range(self.num_layers):
             x = block_cls(self.num_heads, self.mlp_dim, self.dropout_rate,
                           self.dtype, precision=self.precision,
+                          attention=self.attention,
                           name=f"layer_{i}")(x, mask, train)
         return nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)

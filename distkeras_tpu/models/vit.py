@@ -38,6 +38,10 @@ class ViT(nn.Module):
     #: mixed-precision policy (distkeras_tpu/precision.py); f32 head stays
     #: f32
     precision: Optional[str] = None
+    #: "xla" | "flash" — attention kernel dispatch (ops/attention.py);
+    #: ViT attention is bidirectional, so "flash" needs the in-repo
+    #: kernel's non-causal path (falls back to XLA until its flag is on)
+    attention: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -58,7 +62,7 @@ class ViT(nn.Module):
         x = x + pos.astype(dtype)
         x = Encoder(self.num_layers, self.num_heads, self.mlp_dim,
                     self.dropout_rate, self.dtype, remat=self.remat,
-                    precision=self.precision,
+                    precision=self.precision, attention=self.attention,
                     name="encoder")(x, train=train)
         cls_out = x[:, 0]
         return nn.Dense(self.num_classes, dtype=jnp.float32,

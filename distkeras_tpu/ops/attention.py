@@ -11,6 +11,15 @@ makes long-context first-class. This module holds the single-device paths:
 
 The distributed path (ring attention over a sequence-parallel mesh axis)
 lives in ``ops/ring_attention.py``.
+
+Kernel dispatch (DESIGN.md §23): every attention call site routes through
+``apply_attention(..., attention=)`` — a ``precision.resolve()``-style
+switch. ``"xla"`` (default) is the einsum path below; ``"flash"`` prefers
+the in-repo fused Pallas kernel (``ops/pallas/flash_attention.py``) when
+its ablation flag is on AND ``fits()`` accepts the shape, then the
+upstream pallas kernel on TPU, then falls back to the XLA path — the
+switch never errors on an unsupported shape, it just declines the kernel
+(the groupnorm lesson, DESIGN.md §6).
 """
 
 from __future__ import annotations
@@ -50,6 +59,46 @@ def flash_attention_causal(q: jax.Array, k: jax.Array, v: jax.Array
     return out.swapaxes(1, 2).astype(q.dtype)
 
 
+#: legal values for the attention= switch threaded through the model
+#: families (transformer/bert/vit/moe encoders; gpt has its own field
+#: whose "flash" value routes through the same dispatch)
+ATTENTION_MODES = ("xla", "flash")
+
+
+def resolve_attention(attention: Optional[str]) -> str:
+    """Normalize the ``attention=`` model field (None -> ``"xla"``)."""
+    mode = attention or "xla"
+    if mode not in ATTENTION_MODES:
+        raise ValueError(
+            f"attention={attention!r}; expected one of {ATTENTION_MODES}")
+    return mode
+
+
+def apply_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    mask: Optional[jax.Array] = None,
+                    causal: bool = False,
+                    attention: Optional[str] = None) -> jax.Array:
+    """Dispatch one attention call per the resolved mode.
+
+    ``"flash"`` dispatch chain, best first, each link gated on what it
+    can actually handle: in-repo fused kernel (requires its default-off
+    ablation flag, a TPU, a ``fits()``-shaped input, and no padding
+    mask — the kernel only knows the causal mask), else the upstream
+    pallas kernel (TPU, causal only), else the XLA einsum path. The
+    fallback is silent by design: model code picks a mode once and the
+    switch degrades per-shape.
+    """
+    mode = resolve_attention(attention)
+    if mode == "flash" and mask is None:
+        from distkeras_tpu.ops.pallas import flash_attention as _fa
+
+        if _fa.kernel_enabled() and _fa.fits(q.shape):
+            return _fa.flash_attention(q, k, v, causal=causal)
+        if causal:
+            return flash_attention_causal(q, k, v)
+    return dot_product_attention(q, k, v, mask=mask, causal=causal)
+
+
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           mask: Optional[jax.Array] = None,
                           causal: bool = False) -> jax.Array:
@@ -84,6 +133,8 @@ class MultiHeadAttention(nn.Module):
     #: mixed-precision policy for the qkv/out projections
     #: (distkeras_tpu/precision.py); attention itself stays fp32-softmax
     precision: Optional[str] = None
+    #: "xla" | "flash" — kernel dispatch for the attention op itself
+    attention: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask: Optional[jax.Array] = None):
@@ -97,7 +148,8 @@ class MultiHeadAttention(nn.Module):
         qkv = nn.Dense(3 * features, dtype=dtype, name="qkv", **dense_kw)(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         split = lambda t: t.reshape(t.shape[:2] + (self.num_heads, head_dim))
-        out = dot_product_attention(split(q), split(k), split(v),
-                                    mask=mask, causal=self.causal)
+        out = apply_attention(split(q), split(k), split(v),
+                              mask=mask, causal=self.causal,
+                              attention=self.attention)
         out = out.reshape(out.shape[:2] + (features,))
         return nn.Dense(width, dtype=dtype, name="out", **dense_kw)(out)

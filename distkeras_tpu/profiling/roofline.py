@@ -79,7 +79,12 @@ def fix_tag(op: OpCost, bound: str) -> str:
     hint = f"{op.source} {op.name} {' '.join(op.fusion_ops)}".lower()
     if op.opcode in _COLLECTIVES:
         return "comms-overlap"
-    if "attention" in hint or "softmax" in hint:
+    # the attention group announces itself three ways in real HLO: source
+    # annotations ("...attn/..." modules, "attention" paths), softmax
+    # fusions, and the bhqk einsum contraction names dot_product_attention
+    # lowers to — all of them belong to the one fused-kernel fix
+    if ("attention" in hint or "softmax" in hint or "attn" in hint
+            or "bhqk" in hint):
         return "pallas-attention"
     if bound == "compute" and (
             op.opcode in ("dot", "convolution")
@@ -90,6 +95,18 @@ def fix_tag(op: OpCost, bound: str) -> str:
     if bound == "latency":
         return "none-latency"
     return "none-at-roofline"
+
+
+def fix_registry() -> dict:
+    """The in-tree kernel registry keyed by fix tag (ops/pallas), or an
+    empty dict if the kernel package can't import on this host — the
+    report then degrades to tags-only, never errors."""
+    try:
+        from distkeras_tpu.ops.pallas import kernel_registry
+
+        return kernel_registry()
+    except Exception:
+        return {}
 
 
 @dataclass
@@ -106,6 +123,11 @@ class RooflineRow:
     fix: str
     count: int = 1
     measured: bool = False  # est_time_s from a profiler trace
+    #: an in-tree kernel implements this fix tag but its ablation flag is
+    #: OFF — flipping one flag (after its kernel_ablate.py gate passes on
+    #: real hardware) would act on this op. False both when no kernel
+    #: exists AND when the kernel is already enabled (nothing to flip).
+    fix_available: bool = False
 
     def to_row(self) -> dict:
         return {"kind": "op", "op": self.op, "opcode": self.opcode,
@@ -116,7 +138,8 @@ class RooflineRow:
                 "est_time_s": self.est_time_s,
                 "headroom_s": self.headroom_s,
                 "share": round(self.share, 4), "fix": self.fix,
-                "count": self.count, "measured": self.measured}
+                "count": self.count, "measured": self.measured,
+                "fix_available": self.fix_available}
 
 
 @dataclass
@@ -157,7 +180,8 @@ class RooflineReport:
         if self.coverage is not None:
             out["coverage"] = round(self.coverage, 3)
         out["top"] = [{"op": r.op, "bound": r.bound,
-                       "share": round(r.share, 4), "fix": r.fix}
+                       "share": round(r.share, 4), "fix": r.fix,
+                       "fix_available": r.fix_available}
                       for r in self.top()[:3]]
         return out
 
@@ -193,13 +217,15 @@ class RooflineReport:
         for r in self.top():
             ai = "-" if r.intensity is None else f"{r.intensity:.1f}"
             src = "*" if r.measured else " "
+            avail = " [kernel in-tree, off]" if r.fix_available else ""
             lines.append(
                 f"{r.op[:37]:<38}{r.bound:>8}{r.share:>6.1%}{ai:>9}"
                 f"{r.flops/1e9:>9.2f}{r.bytes_accessed/1e6:>9.2f}"
-                f" {src}{r.fix}")
+                f" {src}{r.fix}{avail}")
         lines.append("(* = measured time from a profiler trace; others "
                      "modeled — XLA-style shape arithmetic, not DMA "
-                     "counters)")
+                     "counters; [kernel in-tree, off] = a pallas kernel "
+                     "implements this fix but its ablation flag is off)")
         return "\n".join(lines)
 
 
@@ -262,18 +288,22 @@ def build_report(inventory: OpInventory,
         total_t += est
         measured_t += g["measured_s"]
     total_t = total_t or 1.0
+    registry = fix_registry()
     for key in sorted(groups):
         g = groups[key]
         est = g["measured_s"] + g["modeled_s"]
         bound = classify(g["flops"], g["bytes"], peak_flops, hbm_bandwidth)
         intensity = (g["flops"] / g["bytes"]) if g["bytes"] > 0 else None
         headroom = max(0.0, est - g["flops"] / peak_flops)
+        fix = fix_tag(g["proto"], bound)
+        kernel = registry.get(fix)
         rows.append(RooflineRow(
             op=g["op"], opcode=g["opcode"], bound=bound,
             flops=g["flops"], bytes_accessed=g["bytes"],
             intensity=intensity, est_time_s=est, headroom_s=headroom,
-            share=est / total_t, fix=fix_tag(g["proto"], bound),
-            count=g["count"], measured=g["measured_s"] > 0))
+            share=est / total_t, fix=fix,
+            count=g["count"], measured=g["measured_s"] > 0,
+            fix_available=bool(kernel) and not kernel["enabled"]))
 
     coverage = None
     if modeled_flops:

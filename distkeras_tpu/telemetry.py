@@ -306,6 +306,9 @@ METRIC_NAMES = {
     "profile.op.coverage": "gauge",
     "profile.op.inventory_unavailable": "counter",
     "profile.op.share": "gauge",
+    # attention group's share of modeled step time, baseline-vs-kernel
+    # (regression_gate --check roofline, ISSUE 18)
+    "profile.op.attention_share": "gauge",
     # span names (the `with span("..."):` vocabulary; each also emits a
     # `span.<name>.duration_s` histogram via the prefix family below)
     "serving.compile": "span",

@@ -20,7 +20,7 @@ PKG_MODULES = sorted(
 
 def test_discovery_found_the_tools():
     # the floor protects against the glob silently matching nothing
-    assert len(SCRIPTS) >= 20, SCRIPTS
+    assert len(SCRIPTS) >= 21, SCRIPTS
     assert "distkeras_tpu.benchmarks.run_config" in PKG_MODULES
     # the serving load generator (ISSUE 2) must be under the smoke glob
     assert any(os.path.basename(p) == "serving_load.py" for p in SCRIPTS)
@@ -57,6 +57,8 @@ def test_discovery_found_the_tools():
     assert any(os.path.basename(p) == "roofline_probe.py" for p in SCRIPTS)
     # the routed-serving-fleet probe (ISSUE 17) too
     assert any(os.path.basename(p) == "fleet_probe.py" for p in SCRIPTS)
+    # the shared kernel-ablation harness (ISSUE 18) too
+    assert any(os.path.basename(p) == "kernel_ablate.py" for p in SCRIPTS)
 
 
 def test_step_probe_exposes_sweep_api():
@@ -73,8 +75,14 @@ def test_step_probe_exposes_sweep_api():
     assert callable(mod.largest_batch)
     assert callable(mod.build_family)
     assert callable(mod.overlap_probe)
+    assert callable(mod.joint_probe)
     assert "precision" in inspect.signature(mod.sweep_probe).parameters
     assert "precision" in inspect.signature(mod.build_family).parameters
+    # the attention kernel axis and the joint bucket x overlap grid
+    # (ISSUE 18) must stay addressable
+    assert "attention" in inspect.signature(mod.sweep_probe).parameters
+    assert "attention" in inspect.signature(mod.build_family).parameters
+    assert "comms_overlap" in inspect.signature(mod.joint_probe).parameters
 
 
 def test_decode_bench_exposes_decode_leg_api():
