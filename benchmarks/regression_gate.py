@@ -66,6 +66,18 @@ fleet (``--check fleet``)
     token-identical to local prefill+decode — plus the same
     noise-banded comparison against the prior evidence file.
 
+soak (``--check soak``)
+    Learns the chaos-soak ladder from the committed
+    ``results/pr*_soak.jsonl`` files (soak.py summary rows) and judges
+    the newest one against the DESIGN.md §24 acceptance bars, held
+    forever: the soak ran at least its wall-clock floor, killed every
+    authority (trainer worker, PS coordinator, data coordinator, a
+    serving replica) at least once, lost zero windows and zero data
+    ranges, answered every request token-exact, kept model_version
+    strictly monotone across every publish, and the injected HBM-leak
+    drill was caught by the trend detector AND landed as a typed event
+    in a postmortem bundle.
+
 Verdicts are JSONL rows ``{"kind": "verdict", "check": ..., "metric":
 ..., "status": "pass"|"fail", ...}`` written to ``--out`` (and stdout);
 the process exits 0 iff every verdict passed, so CI can gate on it::
@@ -78,6 +90,7 @@ the process exits 0 iff every verdict passed, so CI can gate on it::
     python benchmarks/regression_gate.py --check decode
     python benchmarks/regression_gate.py --check roofline
     python benchmarks/regression_gate.py --check fleet
+    python benchmarks/regression_gate.py --check soak
 """
 
 from __future__ import annotations
@@ -151,6 +164,37 @@ FLEET_FLOORS = {
     "fleet_probe.affinity_advantage": 0.01,
     "fleet_probe.kill_success_rate": 1.0,
     "fleet_probe.handoff_token_identical": 1.0,
+}
+
+#: soak summary-row field -> gated metric name. The gate names live in
+#: the probe's own ``soak_probe.`` namespace: ``soak.*`` names are the
+#: harness's live instruments (METRIC_NAMES), these are derived
+#: end-of-run verdict inputs. All higher-is-better (booleans as 0/1).
+SOAK_METRICS = {
+    "summary": (
+        ("seconds", "soak_probe.seconds"),
+        ("authorities_killed", "soak_probe.authorities_killed"),
+        ("zero_lost_windows", "soak_probe.zero_lost_windows"),
+        ("request_success_rate", "soak_probe.request_success_rate"),
+        ("version_monotone", "soak_probe.version_monotone"),
+        ("leak_drill_caught", "soak_probe.leak_drill_caught"),
+    ),
+}
+
+#: absolute floors from the soak charter (ISSUE 19 / DESIGN.md §24
+#: acceptance, held forever): a >=120s budget actually spent, every
+#: authority killed at least once, the three flywheel invariants intact,
+#: and the HBM-leak forensic drill caught-and-bundled. Deliberately NOT
+#: gated: cycle/window counts (pure host-speed artifacts) and
+#: zero-trend-breaches (a breach during chaos is the observatory
+#: working — the summary row records them for the reviewer instead).
+SOAK_FLOORS = {
+    "soak_probe.seconds": 120.0,
+    "soak_probe.authorities_killed": 4.0,
+    "soak_probe.zero_lost_windows": 1.0,
+    "soak_probe.request_success_rate": 1.0,
+    "soak_probe.version_monotone": 1.0,
+    "soak_probe.leak_drill_caught": 1.0,
 }
 
 
@@ -251,6 +295,37 @@ def load_fleet_history(repo_dir: str = REPO) -> List[Tuple[int, dict]]:
                     key = (row.get("leg") if row.get("kind") == "leg"
                            else row.get("kind"))
                     for field, name in FLEET_METRICS.get(key, ()):
+                        if row.get(field) is not None:
+                            metrics[name] = row[field]
+        except (OSError, ValueError):
+            continue
+        if metrics:
+            out.append((int(m.group(1)), metrics))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def load_soak_history(repo_dir: str = REPO) -> List[Tuple[int, dict]]:
+    """``[(pr_n, metrics_dict), ...]`` sorted by PR, from the committed
+    ``benchmarks/results/pr*_soak.jsonl`` evidence files (soak.py rows).
+    Metrics are extracted per SOAK_METRICS (the summary row)."""
+    out = []
+    pattern = os.path.join(repo_dir, "benchmarks", "results",
+                           "pr*_soak.jsonl")
+    for path in sorted(glob.glob(pattern)):
+        m = re.search(r"pr(\d+)_soak\.jsonl$", path)
+        if m is None:
+            continue
+        metrics: dict = {}
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    row = json.loads(line)
+                    for field, name in SOAK_METRICS.get(
+                            row.get("kind"), ()):
                         if row.get(field) is not None:
                             metrics[name] = row[field]
         except (OSError, ValueError):
@@ -581,6 +656,18 @@ def judge_fleet(history: List[Tuple[int, dict]],
         "(run benchmarks/fleet_probe.py --jsonl)")
 
 
+def judge_soak(history: List[Tuple[int, dict]],
+               floors: dict = SOAK_FLOORS,
+               noise_floor: float = DEFAULT_NOISE_FLOOR) -> List[dict]:
+    """Chaos-soak ladder gate (see :func:`_judge_ladder`): budget spent,
+    every authority killed, the three flywheel invariants intact, and
+    the leak forensic drill caught — the DESIGN.md §24 acceptance bars."""
+    return _judge_ladder(
+        "soak", history, floors, noise_floor,
+        "no pr*_soak.jsonl evidence committed "
+        "(run benchmarks/soak.py)")
+
+
 # -- CLI --------------------------------------------------------------------
 
 def _emit(verdicts: List[dict], out_path: Optional[str]) -> int:
@@ -603,7 +690,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "BENCH_r*.json release ladder; exit 1 on regression.")
     ap.add_argument("--check",
                     choices=("history", "fresh", "phases", "decode",
-                             "roofline", "fleet"),
+                             "roofline", "fleet", "soak"),
                     default="history")
     ap.add_argument("--repo-dir", default=REPO,
                     help="directory holding BENCH_r*.json")
@@ -652,6 +739,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif args.check == "fleet":
         verdicts = judge_fleet(load_fleet_history(args.repo_dir),
                                noise_floor=args.noise_floor)
+    elif args.check == "soak":
+        verdicts = judge_soak(load_soak_history(args.repo_dir),
+                              noise_floor=args.noise_floor)
     elif args.check == "roofline":
         verdicts = judge_roofline(load_roofline_history(args.repo_dir),
                                   op_budget=args.op_budget)

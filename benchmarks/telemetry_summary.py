@@ -141,6 +141,28 @@ def summarize(rows: list, top: int = 20, ops_section: bool = False) -> str:
                 f"{_fmt(r['p50'], secs):>10s} {_fmt(r['p95'], secs):>10s} "
                 f"{_fmt(r['max'], secs):>10s} {_fmt(mean, secs):>10s}")
 
+    # time-series rows (health/timeseries.py MetricStore.rows(), found in
+    # postmortem bundles and soak reports): one sparkline per series
+    series = [r for r in rows if r.get("kind") == "timeseries"
+              and r.get("points")]
+    if series:
+        from distkeras_tpu.health.timeseries import sparkline
+
+        out.append("\n## time series  (newest points, min..max per line)")
+
+        def series_name(r):
+            base = _full_name(r)
+            field = r.get("field", "value")
+            return base if field == "value" else f"{base}.{field}"
+
+        width = max(len(series_name(r)) for r in series)
+        for r in sorted(series, key=series_name):
+            vals = [p[1] for p in r["points"]]
+            out.append(f"{series_name(r):{width}s}  "
+                       f"{sparkline(vals)}  "
+                       f"[{min(vals):g}..{max(vals):g}] "
+                       f"n={len(vals)} tier={r.get('tier', 'raw')}")
+
     # the headline table: staleness actually experienced at the center
     stal = [r for r in hists if r["name"] == "ps.commit.staleness"
             and r["count"]]

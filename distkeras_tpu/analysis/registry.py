@@ -56,6 +56,7 @@ _CONSUMER_PATHS = (
     "benchmarks/fleet_probe.py",
     "benchmarks/kernel_ablate.py",
     "benchmarks/step_probe.py",
+    "benchmarks/soak.py",
     "distkeras_tpu/profiling/cost_model.py",
     "distkeras_tpu/profiling/roofline.py",
     "distkeras_tpu/profiling/capture.py",
@@ -64,6 +65,7 @@ _CONSUMER_PATHS = (
     "distkeras_tpu/health/slo.py",
     "distkeras_tpu/health/recorder.py",
     "distkeras_tpu/health/cli.py",
+    "distkeras_tpu/health/timeseries.py",
 )
 _FAULT_FUNCS = {"inject", "apply", "clear_injections",
                 "inject_chaos", "chaos", "clear_chaos"}

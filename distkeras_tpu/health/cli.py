@@ -78,6 +78,23 @@ def _fleet_alerts(rows: list) -> list:
     return out
 
 
+def _fleet_trends(rows: list) -> list:
+    """Names of active long-horizon trends (DESIGN.md §24): the
+    ``timeseries.trends_active`` gauges the TrendMonitor flips, minus the
+    per-worker variants (those land in their row's TREND column via
+    :func:`worker_table`)."""
+    out = []
+    for r in rows:
+        labels = r.get("labels") or {}
+        if (r.get("kind") == "gauge"
+                and r.get("name") == "timeseries.trends_active"
+                and r.get("value") and "worker" not in labels):
+            trend = labels.get("trend", "?")
+            if trend not in out:
+                out.append(trend)
+    return out
+
+
 def _fleet_versions(rows: list) -> dict:
     """{engine label: model_version} from the ``rollout.model_version``
     gauges — the fleet version-skew view (one glance says whether every
@@ -193,9 +210,10 @@ def _fleet_ops(rows: list) -> list:
 def _watch_table(workers: dict, prev: dict, interval: float,
                  fleet_alerts: list = (), fleet_versions: dict = (),
                  fleet_decode: dict = (), fleet_data: dict = (),
-                 fleet_ops: list = (), fleet_router: dict = ()) -> str:
+                 fleet_ops: list = (), fleet_router: dict = (),
+                 fleet_trends: list = ()) -> str:
     cols = ("worker", "hb_age", "windows", "win/s", "staleness",
-            "degraded", "alerts", "flag")
+            "degraded", "alerts", "trend", "flag")
     lines = [time.strftime("%H:%M:%S") + "  " +
              " ".join(f"{c:>9s}" for c in cols)]
     for worker in sorted(workers, key=str):
@@ -208,12 +226,15 @@ def _watch_table(workers: dict, prev: dict, interval: float,
         vals = (worker, "-" if age is None else f"{age:.1f}s",
                 str(windows), rate, str(w.get("staleness", "-")),
                 str(w.get("degraded", 0)), str(w.get("alerts", 0)),
+                str(w.get("trends", 0)),
                 "STRAGGLER" if w.get("straggler") else "ok")
         lines.append("          " + " ".join(f"{v:>9s}" for v in vals))
     if len(lines) == 1:
         lines.append("          (no workers reporting yet)")
     if fleet_alerts:
         lines.append(f"          ALERTS: {', '.join(fleet_alerts)}")
+    if fleet_trends:
+        lines.append(f"          TRENDS: {', '.join(fleet_trends)}")
     if fleet_versions:
         skew = " SKEW" if len(set(fleet_versions.values())) > 1 else ""
         lines.append("          VERSIONS: " + ", ".join(
@@ -370,7 +391,8 @@ def main(argv: Optional[list] = None) -> int:
                             fleet_decode=_fleet_decode(rows),
                             fleet_data=_fleet_data(rows),
                             fleet_ops=_fleet_ops(rows),
-                            fleet_router=_fleet_router(rows)),
+                            fleet_router=_fleet_router(rows),
+                            fleet_trends=_fleet_trends(rows)),
                             flush=True)
                         prev_windows = {w: d.get("windows", 0)
                                         for w, d in workers.items()}

@@ -167,9 +167,17 @@ def worker_table(rows: List[dict], now: float) -> Dict[str, dict]:
             w = entry(row.get("labels"))
             if w is not None and row.get("value"):
                 w["alerts"] = w.get("alerts", 0) + 1
+        elif kind == "gauge" and name == "timeseries.trends_active":
+            # per-worker-labelled trend breaches (a stalled window clock
+            # names its worker, DESIGN.md §24) land in that worker's row;
+            # fleet-wide trends are the CLI's TRENDS summary line
+            w = entry(row.get("labels"))
+            if w is not None and row.get("value"):
+                w["trends"] = w.get("trends", 0) + 1
     for w in workers.values():
         w.setdefault("degraded", 0)
         w.setdefault("alerts", 0)
+        w.setdefault("trends", 0)
     return workers
 
 

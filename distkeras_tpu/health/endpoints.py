@@ -17,6 +17,9 @@ op                   reply header
 ``metrics-snapshot`` ``{"snapshot": MetricsRegistry.snapshot()}`` — the
                      full lock-consistent registry view
 ``recent-spans``     ``{"spans": [...]}`` — newest ``limit`` span events
+``series``           ``{"series": MetricStore.rows(...)}`` — windowed
+                     time-series history from the installed store
+                     (DESIGN.md §24); ``[]`` when no store is installed
 ===================  ======================================================
 
 Everything rides in JSON headers (no blobs), so :class:`HealthClient` and
@@ -36,7 +39,7 @@ from typing import Any, Dict, List, Optional
 
 from distkeras_tpu import telemetry
 
-HEALTH_OPS = ("status", "metrics-snapshot", "recent-spans")
+HEALTH_OPS = ("status", "metrics-snapshot", "recent-spans", "series")
 
 #: A worker whose last heartbeat is older than this (seconds) is reported
 #: ``"late"`` in the status digest even if the straggler detector (which
@@ -91,6 +94,19 @@ def handle_health_op(op: str, header: dict,
         return {"snapshot": reg.snapshot()}
     if op == "recent-spans":
         return {"spans": reg.recent_spans(int(header.get("limit", 100)))}
+    if op == "series":
+        # time-series history (DESIGN.md §24): the installed MetricStore's
+        # tiered rings, optionally filtered to one metric name. Lazy
+        # import keeps this module import-light (docstring contract).
+        from distkeras_tpu.health import timeseries
+
+        store = timeseries.get_store()
+        if store is None:
+            return {"series": []}
+        return {"series": store.rows(
+            name=header.get("name"),
+            tier=str(header.get("tier", "raw")),
+            max_points=int(header.get("max_points", 120)))}
     if op == "status":
         now = time.time()
         snap = reg.snapshot()
@@ -140,6 +156,14 @@ def handle_health_op(op: str, header: dict,
         from distkeras_tpu.health import slo as slo_mod
 
         status["alerts"] = slo_mod.active_alerts()
+        # trend judgement (health/timeseries.py, DESIGN.md §24): active
+        # long-horizon trends (leaks/stalls/drift) of the installed
+        # monitor ride the digest next to the instantaneous alerts
+        from distkeras_tpu.health import timeseries as ts_mod
+
+        trends = ts_mod.active_trends()
+        if trends:
+            status["trends"] = trends
         rec = telemetry.get_recorder()
         if rec is not None and hasattr(rec, "last_dump_path"):
             status["recorder"] = {
@@ -269,6 +293,16 @@ class HealthClient:
 
     def recent_spans(self, limit: int = 100) -> List[dict]:
         return self._call("recent-spans", limit=int(limit))["spans"]
+
+    def series(self, name: Optional[str] = None, tier: str = "raw",
+               max_points: int = 120) -> List[dict]:
+        """The peer's stored time-series rows (``[]`` when the peer has no
+        MetricStore installed)."""
+        fields: Dict[str, Any] = {"tier": tier,
+                                  "max_points": int(max_points)}
+        if name is not None:
+            fields["name"] = name
+        return self._call("series", **fields)["series"]
 
     def merged_rows(self) -> List[dict]:
         """The fleet-merged telemetry rows from the peer's collector

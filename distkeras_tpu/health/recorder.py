@@ -37,7 +37,7 @@ import collections
 import json
 import os
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from distkeras_tpu import telemetry
 
@@ -102,6 +102,10 @@ class FlightRecorder:
         self.dump_dir: Optional[str] = None
         self.fingerprint: Dict[str, Any] = {}
         self.roofline: Optional[Dict[str, Any]] = None
+        # named digest callables polled at bundle time (the fleet router
+        # registers status_digest here; anything returning a plain dict
+        # qualifies — the recorder stays jax-free)
+        self._digest_sources: Dict[str, Callable[[], Dict[str, Any]]] = {}
         self.last_dump_path: Optional[str] = None
         # distinct reasons already auto-dumped: one bundle per failure
         # class per process, not one per retry of the same failure
@@ -136,6 +140,29 @@ class FlightRecorder:
         stays jax-free (it only stores the dict)."""
         self.roofline = dict(digest)
 
+    def set_digest_source(self, name: str,
+                          fn: Optional[Callable[[], Dict[str, Any]]]
+                          ) -> None:
+        """Register (None: remove) a named live-digest callable polled at
+        bundle time — ``FleetRouter.status_digest`` registers itself as
+        ``"fleet"`` so postmortems carry the routing table, version skew
+        and shed counts the moment the run died. Callables must return a
+        JSON-serializable dict; a raising source degrades to an error
+        string in the bundle, never a failed dump."""
+        if fn is None:
+            self._digest_sources.pop(name, None)
+        else:
+            self._digest_sources[name] = fn
+
+    def _collect_digests(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for name, fn in list(self._digest_sources.items()):
+            try:
+                out[name] = fn()
+            except Exception as e:  # a half-dead source must not kill dumps
+                out[name] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
     def events(self) -> List[dict]:
         """The ring as row dicts (oldest first)."""
         return [{"time": t, "kind": kind, **({"fields": fields})}
@@ -166,6 +193,14 @@ class FlightRecorder:
             status = handle_health_op("status", {})
         except Exception as e:  # pragma: no cover - defensive
             status = {"error": f"{type(e).__name__}: {e}"}
+        try:  # installed MetricStore history + active trends (§24)
+            from distkeras_tpu.health import timeseries
+
+            store = timeseries.get_store()
+            series = store.rows(max_points=60) if store is not None else []
+            trends = timeseries.active_trends()
+        except Exception:  # pragma: no cover - defensive
+            series, trends = [], []
         return {
             "kind": "postmortem",
             "reason": reason,
@@ -175,6 +210,9 @@ class FlightRecorder:
                 os.path.dirname(os.path.abspath(__file__))))),
             "fingerprint": dict(self.fingerprint),
             "roofline": dict(self.roofline) if self.roofline else None,
+            "digests": self._collect_digests(),
+            "timeseries": series,
+            "trends": trends,
             "last_trace_ids": self.last_trace_ids(),
             "status": status,
             "events": self.events(),
@@ -314,6 +352,9 @@ def merge_bundles(paths: List[str]) -> dict:
                        if e.get("kind") == "alert"],
             "rollouts": [e for e in b.get("events", [])
                          if e.get("kind") == "rollout"],
+            "trends": [e for e in b.get("events", [])
+                       if e.get("kind") == "trend"],
+            "fleet": (b.get("digests") or {}).get("fleet"),
         } for b in bundles],
         "processes": sorted({b.get("process_index", 0) for b in bundles}),
         "last_trace_ids": trace_ids,
@@ -341,6 +382,18 @@ def render_timeline(merged: dict, limit: int = 60) -> str:
             desc = " ".join(f"{k}={v}" for k, v in f.items()
                             if k != "action")
             out.append(f"    ROLLOUT {f.get('action', '?')}: {desc}")
+        for ev in b.get("trends", []):
+            f = ev.get("fields", {})
+            state = "resolved" if f.get("resolved") else "active"
+            out.append(f"    TREND {f.get('trend', '?')} [{state}]: "
+                       f"{f.get('message', '')}")
+        fleet = b.get("fleet")
+        if isinstance(fleet, dict) and "error" not in fleet:
+            out.append(f"    FLEET replicas={len(fleet.get('replicas', []))}"
+                       f" requests={fleet.get('requests', 0)}"
+                       f" sheds={fleet.get('sheds', 0)}"
+                       f" requeued={fleet.get('requeued', 0)}"
+                       f" version_skew={fleet.get('version_skew', 0)}")
     if merged.get("last_trace_ids"):
         out.append("last traces: " +
                    ", ".join(merged["last_trace_ids"][:8]))

@@ -146,9 +146,49 @@ class SloEngine:
         self._thread: Optional[threading.Thread] = None
 
     # -- observation -------------------------------------------------------
+    def _observe_store(self, spec: SloSpec, now: float) -> Optional[float]:
+        """Windowed observation from the installed
+        :class:`~distkeras_tpu.health.timeseries.MetricStore` (DESIGN.md
+        §24), or None to fall back to the single-snapshot path: no store
+        installed, the store has not seen the metric, the field is not
+        retained (histogram ``min``), or a rate window holds fewer than
+        two points. Histogram tails judge the WORST point over the spec's
+        window across matching label sets — real history instead of one
+        conservative snapshot; on a static series both paths agree
+        (parity-tested)."""
+        from distkeras_tpu.health import timeseries  # lazy: no import cycle
+        store = timeseries.get_store()
+        if store is None:
+            return None
+        window = spec.window_s if spec.window_s > 0 else None
+        if spec.field in ("p50", "p95", "max"):
+            vals = []
+            for s in store.query(spec.metric, spec.labels, spec.field):
+                pts = (s.points(window, now=now) if window
+                       else list(s.rings["raw"])[-1:])
+                vals.extend(v for _, v in pts)
+            if not vals:
+                return None
+            return max(vals) if spec.op in ("<=", "<") else min(vals)
+        if spec.field == "min":
+            return None  # the store does not retain histogram min
+        matched = store.query(spec.metric, spec.labels, "value")
+        if not matched:
+            return None
+        if spec.field == "rate" and matched[0].kind == "counter":
+            return store.rate(spec.metric, spec.labels,
+                              window_s=spec.window_s or 60.0, now=now)
+        if matched[0].kind == "histogram":
+            return None  # "value" on a histogram: snapshot path picks p95
+        return store.latest(spec.metric, spec.labels, "value")
+
     def _observe(self, spec: SloSpec, now: float) -> Optional[float]:
-        """The spec's observed value from the live registry, or None when
+        """The spec's observed value — windowed store history when a
+        MetricStore is installed, else the live registry — or None when
         the metric has produced nothing yet."""
+        got = self._observe_store(spec, now)
+        if got is not None:
+            return got
         reg = telemetry.get_registry()
         if reg is None:
             return None
@@ -280,9 +320,14 @@ def default_specs(mfu_floor: float = 0.50,
                   ttft_p95_s: float = 2.0,
                   degraded_rate: float = 0.5,
                   queue_depth: float = 512.0,
-                  canary_floor: float = 0.98) -> List[SloSpec]:
+                  canary_floor: float = 0.98,
+                  collector_drop_rate: float = 1.0) -> List[SloSpec]:
     """The stock objectives for a training+serving process; callers prune
-    or reparameterize for their workload."""
+    or reparameterize for their workload. The long-horizon specs at the
+    end judge the trend monitor's ``timeseries.trends_active`` gauges
+    (DESIGN.md §24) — they stay silent until a
+    :class:`~distkeras_tpu.health.timeseries.TrendMonitor` is evaluating
+    (``require_present``)."""
     return [
         SloSpec("mfu-floor", "observability.mfu", mfu_floor, op=">=",
                 window_s=60.0, budget_frac=0.5, severity="ticket"),
@@ -301,6 +346,21 @@ def default_specs(mfu_floor: float = 0.50,
         # breach rolls the fleet back instead of raising
         SloSpec("canary-agreement", "rollout.canary.agreement",
                 canary_floor, op=">=", severity="page"),
+        # long-horizon failure modes (ISSUE 19): an hours-scale run dies
+        # of leaks and stalls, not of one bad sample. hbm-growth trips on
+        # the LeakDetector over observability.hbm_allocated_bytes;
+        # data-watermark-stall on the StallDetector over
+        # data.service.cursor; collector-drops rates the collector's own
+        # drop counter over a minute (loss of telemetry is itself a
+        # failure of the forensic record).
+        SloSpec("hbm-growth", "timeseries.trends_active", 0.0, op="<=",
+                labels={"trend": "hbm-leak"}, severity="page"),
+        SloSpec("data-watermark-stall", "timeseries.trends_active", 0.0,
+                op="<=", labels={"trend": "data-watermark-stall"},
+                severity="page"),
+        SloSpec("collector-drops", "collector.dropped_batches",
+                collector_drop_rate, op="<=", field="rate",
+                window_s=60.0, budget_frac=0.25, severity="ticket"),
     ]
 
 

@@ -167,6 +167,13 @@ class FleetRouter:
         self._evictions_c = telemetry.counter("fleet.evictions")
         self._aff_hits_c = telemetry.counter("fleet.affinity.hits")
         self._aff_miss_c = telemetry.counter("fleet.affinity.misses")
+        # forensic record (ISSUE 19): postmortem bundles carry the routing
+        # table / version skew / shed tallies the moment the run died.
+        # Duck-typed like set_roofline — the recorder polls the digest at
+        # bundle time, this module never imports recorder machinery.
+        rec = telemetry.get_recorder()
+        if rec is not None and hasattr(rec, "set_digest_source"):
+            rec.set_digest_source("fleet", self.status_digest)
 
     # -- replica pool ------------------------------------------------------
 
@@ -614,6 +621,9 @@ class FleetRouter:
             }
 
     def close(self) -> None:
+        rec = telemetry.get_recorder()
+        if rec is not None and hasattr(rec, "set_digest_source"):
+            rec.set_digest_source("fleet", None)
         with self._lock:
             reps = list(self._replicas.values())
             self._replicas.clear()

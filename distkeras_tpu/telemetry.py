@@ -278,6 +278,26 @@ METRIC_NAMES = {
     "recorder.events": "counter",
     # artifact loading (load_jsonl crash-tail recovery accounting)
     "telemetry.load.truncated_tail": "counter",
+    # time-series metrics plane (health/timeseries.py, DESIGN.md §24):
+    # bounded tiered history of the registry + trend detection
+    "timeseries.collect_s": "histogram",
+    "timeseries.collections": "counter",
+    "timeseries.dropped_series": "counter",
+    "timeseries.points": "gauge",
+    "timeseries.series": "gauge",
+    "timeseries.trend_breaches": "counter",
+    "timeseries.trends_active": "gauge",
+    # chaos soak harness (benchmarks/soak.py): wall-clock-budgeted
+    # whole-loop run under a seeded kill schedule
+    "soak.cycles": "counter",
+    "soak.elapsed_s": "gauge",
+    "soak.failed_requests": "counter",
+    "soak.kills": "counter",
+    "soak.lost_windows": "counter",
+    "soak.model_version": "gauge",
+    "soak.requests": "counter",
+    "soak.version_regressions": "counter",
+    "soak.windows": "counter",
     # fleet telemetry collector (health/collector.py; lives on shard 0)
     "collector.batches": "counter",
     "collector.dropped_batches": "counter",

@@ -20,7 +20,7 @@ PKG_MODULES = sorted(
 
 def test_discovery_found_the_tools():
     # the floor protects against the glob silently matching nothing
-    assert len(SCRIPTS) >= 21, SCRIPTS
+    assert len(SCRIPTS) >= 22, SCRIPTS
     assert "distkeras_tpu.benchmarks.run_config" in PKG_MODULES
     # the serving load generator (ISSUE 2) must be under the smoke glob
     assert any(os.path.basename(p) == "serving_load.py" for p in SCRIPTS)
@@ -59,6 +59,8 @@ def test_discovery_found_the_tools():
     assert any(os.path.basename(p) == "fleet_probe.py" for p in SCRIPTS)
     # the shared kernel-ablation harness (ISSUE 18) too
     assert any(os.path.basename(p) == "kernel_ablate.py" for p in SCRIPTS)
+    # the chaos-soak observatory harness (ISSUE 19) too
+    assert any(os.path.basename(p) == "soak.py" for p in SCRIPTS)
 
 
 def test_step_probe_exposes_sweep_api():

@@ -457,6 +457,7 @@ def test_recorder_bundle_carries_roofline_digest():
     from distkeras_tpu.health.recorder import FlightRecorder
 
     telemetry.reset()
+    prev = telemetry.get_recorder()
     try:
         rec = FlightRecorder(capacity=8)
         telemetry.set_recorder(rec)
@@ -466,5 +467,7 @@ def test_recorder_bundle_carries_roofline_digest():
         rec.clear()
         assert rec.roofline is None
     finally:
-        telemetry.set_recorder(None)
+        # restore, don't clear: leaving the sink at None would silently
+        # no-op record_event() for every test that runs after this one
+        telemetry.set_recorder(prev)
         telemetry.reset()
