@@ -30,6 +30,22 @@ building its own workload shape from a fixed internal seed:
   for both, the speedup, the accept rate, and whether the outputs are
   token-identical (they must be — speculation is exact).
 
+Three long-context economics legs (ISSUE 20):
+
+- **interference**: steady decode streams measure per-token gap
+  latency while 12 long prefills are admitted mid-stream, unchunked vs
+  ``prefill_chunk=8``; reports p50/p99 gaps and the p99 improvement
+  (acceptance: >= 2x).
+- **kv_capacity**: the same page-byte budget backs a native pool and
+  an int8 pool; reports peak resident conversations for both, their
+  ratio (acceptance: >= 1.8x), and the quantizer round-trip error
+  receipt (per-cell error <= scale/2).
+- **sampled**: seeded temperature sampling, plain vs spec_k=3 +
+  n-gram draft; the min(1, p/q) accept rule keeps the streams
+  IDENTICAL, so the leg reports the identity receipt and the speedup
+  (acceptance: >= 1x — sampling must not turn speculation into a
+  regression).
+
 Prints one JSON line per mode plus a summary row with the speedup
 ratios (ISSUE 9 acceptance: continuous >= 3x naive tokens/s at
 batch >= 4 on the CPU host). Tokens/s counts USEFUL tokens only
@@ -40,7 +56,8 @@ serving, not cold start.
 
 Usage:
   python benchmarks/decode_bench.py [--requests 8] [--slots 4]
-      [--modes naive,static,continuous,prefix,longtail,speculative]
+      [--modes naive,static,continuous,prefix,longtail,speculative,
+               interference,kv_capacity,sampled]
       [--seed 0]
 
 CPU-safe (gpt_tiny); on a TPU host the same script exercises the device
@@ -387,6 +404,186 @@ def run_speculative(model, params, prompts, max_news, lanes: int,
             "outputs_identical": plain_out == spec_out}
 
 
+def run_interference(model, params, prompts, max_news, lanes: int,
+                     rounds: int = 3) -> dict:
+    """Prefill-interference leg (ISSUE 20): ``lanes`` steady decode
+    streams measure per-token gap latency while 12 long (96-token)
+    prefills are admitted mid-stream. Unchunked, each admission stalls
+    every decode lane for a full bucket-96 prefill; with
+    ``prefill_chunk=8`` the prefill rides the decode ladder in
+    8-token slices. Reports pooled p50/p99 decode-token gaps for both
+    modes (median over ``rounds``) and the p99 improvement ratio —
+    the acceptance floor is 2x."""
+    from distkeras_tpu.serving.generation import GenerationEngine
+
+    rng = np.random.default_rng(LEG_SEED)
+    dec_prompts = [rng.integers(1, 256, size=8).tolist()
+                   for _ in range(lanes)]
+    long_prompts = [rng.integers(1, 256, size=96).tolist()
+                    for _ in range(12)]
+
+    def drive(chunk: bool):
+        kw = {"prefill_chunk": 8} if chunk else {}
+        eng = GenerationEngine(model, params, num_slots=lanes + 2,
+                               prefill_buckets=(8, 32, 96),
+                               queue_capacity=64, page_size=16, **kw)
+        try:
+            p50s, p99s = [], []
+            for _ in range(rounds):
+                stamps = [[] for _ in range(lanes)]
+                futs = []
+                for i in range(lanes):
+                    stream = (lambda tok, i=i:
+                              stamps[i].append(time.perf_counter()))
+                    futs.append(eng.generate(dec_prompts[i],
+                                             max_new_tokens=96,
+                                             stream=stream))
+                while any(len(s) < 4 for s in stamps):
+                    time.sleep(0.0002)
+                # admit the prefill storm in batches so the stalls
+                # spread across the decode window instead of landing
+                # in one scheduler iteration
+                lfuts = []
+                for b in range(0, len(long_prompts), 4):
+                    lfuts += [eng.generate(p, max_new_tokens=1)
+                              for p in long_prompts[b:b + 4]]
+                    time.sleep(0.003)
+                for f in futs + lfuts:
+                    f.result(timeout=600)
+                gaps = np.concatenate([np.diff(s) for s in stamps])
+                p50s.append(float(np.percentile(gaps, 50)))
+                p99s.append(float(np.percentile(gaps, 99)))
+        finally:
+            eng.shutdown()
+        return sorted(p50s)[rounds // 2], sorted(p99s)[rounds // 2]
+
+    p50_un, p99_un = drive(chunk=False)
+    p50_ch, p99_ch = drive(chunk=True)
+    return {"rounds": rounds, "decode_streams": lanes,
+            "long_prefills": len(long_prompts), "prefill_chunk": 8,
+            "p50_gap_unchunked_s": p50_un, "p99_gap_unchunked_s": p99_un,
+            "p50_gap_chunked_s": p50_ch, "p99_gap_chunked_s": p99_ch,
+            "p99_improvement": p99_un / p99_ch}
+
+
+def run_kv_capacity(model, params, prompts, max_news, lanes: int) -> dict:
+    """KV-capacity leg (ISSUE 20): the SAME page-byte budget backs a
+    native-dtype pool and an int8 pool; 24 identical conversations
+    (16-token prompt, 48 new tokens -> 4 pages each) are offered to
+    both and the peak resident-conversation count is polled. Admission
+    reserves all-or-nothing, so the peak IS the capacity. Also emits
+    the quantizer round-trip receipt: per-cell |dequant - orig| <=
+    scale/2 on random pages (acceptance floor: ratio >= 1.8x, bound
+    held)."""
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models.gpt import (dequantize_kv_page, page_bytes,
+                                          quantize_kv_page)
+    from distkeras_tpu.serving.generation import GenerationEngine
+
+    rng = np.random.default_rng(LEG_SEED)
+    page_size = 16
+    budget = 24 * page_bytes(model, page_size)
+    reqs = [rng.integers(1, 256, size=16).tolist() for _ in range(24)]
+
+    def drive(kv_dtype):
+        pb = page_bytes(model, page_size, kv_dtype=kv_dtype)
+        num_pages = budget // pb
+        eng = GenerationEngine(model, params, num_slots=len(reqs),
+                               prefill_buckets=PREFILL_BUCKETS,
+                               queue_capacity=64, page_size=page_size,
+                               num_pages=num_pages, kv_dtype=kv_dtype)
+        try:
+            futs = [eng.generate(p, max_new_tokens=48) for p in reqs]
+            peak = 0
+            while not all(f.done() for f in futs):
+                peak = max(peak, eng.pool.num_active)
+                time.sleep(0.0002)
+            for f in futs:
+                f.result(timeout=600)
+            saved = eng.pool.kv_quant_bytes_saved
+        finally:
+            eng.shutdown()
+        return peak, int(num_pages), saved
+
+    peak_nat, pages_nat, _ = drive("native")
+    peak_int8, pages_int8, saved = drive("int8")
+
+    # quantizer round-trip receipt on random pages across scales
+    ok = True
+    for i in range(4):
+        page = jnp.asarray(rng.normal(
+            scale=10.0 ** (i - 2), size=(2, page_size, model.num_heads,
+                                         model.width // model.num_heads)
+        ).astype(np.float32))
+        codes, scale = quantize_kv_page(page)
+        err = np.abs(np.asarray(dequantize_kv_page(codes, scale))
+                     - np.asarray(page))
+        bound = np.asarray(scale)[:, None, None, None] / 2
+        ok = ok and bool(np.all(err <= bound + 1e-7))
+
+    return {"page_budget_bytes": int(budget), "page_size": page_size,
+            "requests": len(reqs),
+            "num_pages_native": pages_nat, "num_pages_int8": pages_int8,
+            "peak_resident_native": int(peak_nat),
+            "peak_resident_int8": int(peak_int8),
+            "capacity_ratio": peak_int8 / max(peak_nat, 1),
+            "kv_quant_bytes_saved": int(saved),
+            "err_within_bound": float(ok)}
+
+
+def run_sampled(model, params, prompts, max_news, lanes: int,
+                rounds: int = 3) -> dict:
+    """Sampled-speculation leg (ISSUE 20): seeded temperature sampling
+    through a plain engine and a spec_k=3 + NgramDraft engine. The
+    min(1, p/q) accept rule with the shared per-request stream makes
+    the two engines STREAM-IDENTICAL (NUMERICS.md), so speculation is
+    again a pure latency move — reports both tokens/s (median of
+    ``rounds``), the identity receipt, and the speedup (floor 1.0:
+    sampling must not make speculation a regression)."""
+    from distkeras_tpu.serving.generation import GenerationEngine, NgramDraft
+
+    max_new = 96
+    # low temperature: the n-gram draft's point-mass proposals only pay
+    # off when sampling is near-greedy; hotter workloads should pick a
+    # distribution-matched draft instead (NUMERICS.md)
+    temperature = 0.05
+
+    def drive(**kw):
+        eng = GenerationEngine(model, params, num_slots=lanes,
+                               prefill_buckets=PREFILL_BUCKETS,
+                               queue_capacity=max(64, len(prompts)),
+                               sampling=True, temperature=temperature,
+                               seed=LEG_SEED, **kw)
+        try:
+            tps, outs = [], []
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                futs = [eng.generate(p, max_new_tokens=max_new)
+                        for p in prompts]
+                round_outs = [f.result(timeout=600).tokens.tolist()
+                              for f in futs]
+                wall = time.perf_counter() - t0
+                outs.append(round_outs)
+                total = sum(len(t) for t in round_outs)
+                tps.append(total / wall)
+            status = eng.health_status()
+        finally:
+            eng.shutdown()
+        return sorted(tps)[rounds // 2], outs, status
+
+    plain_tps, plain_outs, _ = drive()
+    spec_tps, spec_outs, status = drive(draft=NgramDraft(ngram=2),
+                                        spec_k=3)
+    sp = status["speculative"]
+    return {"rounds": rounds, "temperature": temperature,
+            "seed": LEG_SEED, "tokens_per_s": spec_tps,
+            "plain_tokens_per_s": plain_tps,
+            "speedup_vs_plain": spec_tps / plain_tps,
+            "spec_k": sp["spec_k"], "accept_rate": sp["accept_rate"],
+            "sampled_identity": float(plain_outs == spec_outs)}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=8)
@@ -401,7 +598,9 @@ def main(argv=None) -> int:
     prompts, max_news = _workload(args.requests, args.seed)
     runners = {"naive": run_naive, "static": run_static,
                "continuous": run_continuous, "prefix": run_prefix,
-               "longtail": run_longtail, "speculative": run_speculative}
+               "longtail": run_longtail, "speculative": run_speculative,
+               "interference": run_interference,
+               "kv_capacity": run_kv_capacity, "sampled": run_sampled}
     base = {"bench": "decode", "requests": args.requests,
             "slots": args.slots, "platform": jax.default_backend(),
             "model": "gpt_tiny", "seed": args.seed}

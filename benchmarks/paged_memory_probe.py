@@ -19,9 +19,15 @@ having, DESIGN.md §19):
   (the cost of larger pages; the reason page_size is a dial, not "as
   big as possible").
 
+The ``--kv-dtypes`` axis (ISSUE 20) re-runs the sweep with pages sized
+in the int8 quantized-KV format — codes plus two f32 scales per page —
+against the SAME native-dtype rect budget, so ``slots_equiv`` directly
+shows the compounding of paging x quantization.
+
 Usage:
   python benchmarks/paged_memory_probe.py [--slots 64]
-      [--page-sizes 8,16,32,64] [--requests 512] [--seed 0]
+      [--page-sizes 8,16,32,64] [--kv-dtypes native,int8]
+      [--requests 512] [--seed 0]
 
 JSONL rows on stdout, convention matching decode_bench.py.
 """
@@ -54,17 +60,23 @@ def longtail_lengths(max_len: int, requests: int, seed: int) -> np.ndarray:
                     np.where(kind == 1, med, max_len)).astype(np.int64)
 
 
-def probe(model, page_size: int, lengths: np.ndarray, slots: int) -> dict:
-    """Rect-vs-paged budget math for one page size over one length mix."""
+def probe(model, page_size: int, lengths: np.ndarray, slots: int,
+          kv_dtype=None) -> dict:
+    """Rect-vs-paged budget math for one page size over one length mix.
+
+    ``kv_dtype="int8"`` sizes the pages in the quantized-KV format
+    (ISSUE 20); the rect budget stays native-dtype, because the claim
+    is "what fits in the HBM a rect pool would burn", not "what fits
+    if the rect pool were quantized too"."""
     from distkeras_tpu.models.gpt import page_bytes
 
     max_len = int(model.max_len)
     if max_len % page_size:
         raise ValueError(f"page_size {page_size} must divide "
                          f"max_len {max_len}")
-    pb = page_bytes(model, page_size)
+    pb = page_bytes(model, page_size, kv_dtype=kv_dtype)
     pages_per_slot = max_len // page_size
-    rect_per_slot = pages_per_slot * pb
+    rect_per_slot = pages_per_slot * page_bytes(model, page_size)
     pages = np.ceil(lengths / page_size).astype(np.int64)
     paged_per_req = pages * pb
     frag = pages * page_size - lengths  # idle cells in the last page
@@ -72,6 +84,7 @@ def probe(model, page_size: int, lengths: np.ndarray, slots: int) -> dict:
     slots_equiv = int(rect_budget // max(1, int(paged_per_req.mean())))
     return {
         "page_size": page_size,
+        "kv_dtype": kv_dtype or "native",
         "page_bytes": pb,
         "pages_per_slot": pages_per_slot,
         "rect_bytes_per_slot": rect_per_slot,
@@ -85,14 +98,19 @@ def probe(model, page_size: int, lengths: np.ndarray, slots: int) -> dict:
     }
 
 
-def sweep(model, page_sizes, lengths: np.ndarray, slots: int) -> list:
-    return [probe(model, ps, lengths, slots) for ps in page_sizes]
+def sweep(model, page_sizes, lengths: np.ndarray, slots: int,
+          kv_dtypes=("native",)) -> list:
+    return [probe(model, ps, lengths, slots, kv_dtype=kd)
+            for kd in kv_dtypes for ps in page_sizes]
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--slots", type=int, default=64)
     ap.add_argument("--page-sizes", default="8,16,32,64")
+    ap.add_argument("--kv-dtypes", default="native,int8",
+                    help="comma list of KV page formats to sweep "
+                         "(native, int8)")
     ap.add_argument("--requests", type=int, default=512)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
@@ -105,15 +123,26 @@ def main(argv=None) -> int:
             "max_len": int(model.max_len), "slots": args.slots,
             "requests": args.requests, "seed": args.seed}
     page_sizes = [int(s) for s in args.page_sizes.split(",") if s]
+    kv_dtypes = [s.strip() for s in args.kv_dtypes.split(",") if s]
     best = None
-    for row in sweep(model, page_sizes, lengths, args.slots):
+    by_key = {}
+    for row in sweep(model, page_sizes, lengths, args.slots, kv_dtypes):
         print(json.dumps(dict(base, mode="probe", **row)))
+        by_key[(row["kv_dtype"], row["page_size"])] = row
         if best is None or row["slots_equiv"] > best["slots_equiv"]:
             best = row
-    print(json.dumps(dict(
+    summary = dict(
         base, mode="summary", best_page_size=best["page_size"],
+        best_kv_dtype=best["kv_dtype"],
         best_slots_equiv=best["slots_equiv"],
-        best_slots_gain=best["slots_gain"])))
+        best_slots_gain=best["slots_gain"])
+    if "native" in kv_dtypes and "int8" in kv_dtypes:
+        # headline ISSUE-20 ratio: same page size, quantized vs native
+        ps = page_sizes[0]
+        summary["int8_bytes_ratio"] = (
+            by_key[("native", ps)]["page_bytes"]
+            / by_key[("int8", ps)]["page_bytes"])
+    print(json.dumps(summary))
     return 0
 
 

@@ -129,6 +129,13 @@ DECODE_METRICS = {
     "prefix": (("ttft_speedup", "decode.prefix.ttft_speedup"),),
     "speculative": (("speedup_vs_plain", "decode.spec.speedup_vs_plain"),),
     "longtail": (("hbm_ratio_rect_over_paged", "decode.paged.hbm_ratio"),),
+    # long-context serving economics (ISSUE 20)
+    "interference": (("p99_improvement",
+                      "decode.chunk.interference_improvement"),),
+    "kv_capacity": (("capacity_ratio", "decode.kv.capacity_ratio"),
+                    ("err_within_bound", "decode.kv.err_within_bound")),
+    "sampled": (("sampled_identity", "decode.spec.sampled_identity"),
+                ("speedup_vs_plain", "decode.spec.sampled_speedup")),
 }
 
 #: absolute floors from the serving charter (ISSUE 9 / DESIGN.md §19
@@ -138,6 +145,12 @@ DECODE_FLOORS = {
     "decode.speedup_vs_naive": 3.0,
     "decode.prefix.ttft_speedup": 2.0,
     "decode.spec.speedup_vs_plain": 1.0,
+    # long-context serving economics (ISSUE 20)
+    "decode.chunk.interference_improvement": 2.0,
+    "decode.kv.capacity_ratio": 1.8,
+    "decode.kv.err_within_bound": 1.0,
+    "decode.spec.sampled_identity": 1.0,
+    "decode.spec.sampled_speedup": 1.0,
 }
 
 #: fleet-probe row field -> gated metric name, keyed by the row's leg

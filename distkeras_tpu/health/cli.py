@@ -109,18 +109,27 @@ def _fleet_versions(rows: list) -> dict:
 
 
 def _fleet_decode(rows: list) -> dict:
-    """Decode-plane gauges worth one glance in the fleet table: prefix-cache
-    hit rate and KV page occupancy (``serving.decode.prefix.hit_rate`` /
-    ``serving.decode.paged.page_occupancy``, DESIGN.md §19). Keys appear
-    only when an engine exports the gauge, so non-generative fleets pay
-    no extra line."""
+    """Decode-plane gauges worth one glance in the fleet table:
+    prefix-cache hit rate, KV page occupancy, chunked-prefill queue
+    depth, and int8-KV megabytes saved
+    (``serving.decode.prefix.hit_rate`` /
+    ``serving.decode.paged.page_occupancy`` /
+    ``serving.decode.chunk.queue_depth`` /
+    ``serving.decode.paged.kv_quant_bytes_saved``, DESIGN.md §19).
+    Keys appear only when an engine exports the gauge, so fleets not
+    using a feature pay no extra field."""
     out = {}
-    wanted = {"serving.decode.prefix.hit_rate": "prefix_hit_rate",
-              "serving.decode.paged.page_occupancy": "page_occupancy"}
+    wanted = {"serving.decode.prefix.hit_rate": ("prefix_hit_rate", 1.0),
+              "serving.decode.paged.page_occupancy": ("page_occupancy",
+                                                      1.0),
+              "serving.decode.chunk.queue_depth": ("chunk_queue", 1.0),
+              "serving.decode.paged.kv_quant_bytes_saved": ("kv_saved_mb",
+                                                            1e-6)}
     for r in rows:
-        label = wanted.get(r.get("name"))
-        if label and r.get("kind") == "gauge":
-            out[label] = float(r.get("value", 0.0))
+        picked = wanted.get(r.get("name"))
+        if picked and r.get("kind") == "gauge":
+            label, scale = picked
+            out[label] = float(r.get("value", 0.0)) * scale
     return out
 
 

@@ -47,6 +47,19 @@ logits stay bitwise-equal to rectangular decode (asserted in
 tests/test_paged_generation.py). The new K/V block is then scattered to
 its physical page cells; positions past ``max_len`` (the ghost slot)
 and cells of unmapped table entries land in the scratch page.
+
+Int8 KV pages (DESIGN.md §19, ISSUE 20): when the paged cache carries
+``k_scale``/``v_scale`` leaves (:func:`init_paged_cache` with
+``kv_dtype="int8"``), pages store int8 codes on the wire codec's
+symmetric affine grid — one f32 scale per (page, layer, k/v), the SAME
+``affine_qparams(-amax, amax, 254)`` rule precision.py and comms/codec
+share — and the forward dequantizes at the gather, overlays the exact
+in-call block, attends, then requantizes ONLY the pages the block
+touched. Pages fill monotonically, so a full page's codes freeze
+forever; the per-encode error is bounded by ``scale / 2`` per cell
+(:func:`quantize_kv_page`). Lossy by design: ~4x capacity per HBM byte
+at f32 compute (:func:`page_bytes` with ``kv_dtype="int8"``) for a
+stated, tested error bound — never silently on (the pool opts in).
 """
 
 from __future__ import annotations
@@ -93,6 +106,17 @@ class CausalSelfAttention(nn.Module):
             if page_table is not None:
                 from distkeras_tpu.ops.pallas import flash_attention as _fa
 
+                if "k_scale" in cache:
+                    # int8 KV pages (module docstring): dequantize at
+                    # the gather, overlay the exact in-call block,
+                    # attend, requantize only the touched page window
+                    out, new_cache = _paged_int8_attention(
+                        q, k, v, cache, page_table, pos, cache_index,
+                        _fa)
+                    out = out.reshape(out.shape[:2] + (width,))
+                    out = nn.Dense(width, dtype=dtype, name="out",
+                                   **dense_kw)(out)
+                    return out, new_cache
                 ps = cache["k"].shape[1]
                 pmax = page_table.shape[1]
                 max_len = pmax * ps
@@ -296,26 +320,170 @@ def init_cache(model: CausalLM, batch: int, dtype=None):
 
 
 def init_paged_cache(model: CausalLM, num_pages: int, page_size: int,
-                     dtype=None):
+                     dtype=None, kv_dtype=None):
     """Zeroed shared page pool for paged decode (DESIGN.md §19): a tuple
     (one entry per layer) of ``{"k", "v"}`` arrays shaped
     ``[num_pages + 1, page_size, num_heads, head_dim]``. One logical
     page spans every layer (the same page id indexes each layer's
     array), so a page costs :func:`page_bytes` of HBM. The extra LAST
     page is **scratch**: unmapped page-table entries and ghost/overflow
-    writes point at it, mirroring the rectangular pool's scratch row."""
+    writes point at it, mirroring the rectangular pool's scratch row.
+
+    ``kv_dtype="int8"`` switches the page format to symmetric int8
+    codes plus per-page f32 ``k_scale``/``v_scale`` leaves shaped
+    ``[num_pages + 1]`` (module docstring, "Int8 KV pages"); the
+    attention path detects the format by the presence of the scale
+    leaves, so every consumer that treats the pool as a pytree
+    (host swap, prefix cache, fleet kv_export/kv_handoff) ships the
+    quantized blobs unchanged."""
+    if kv_dtype not in (None, "native", "int8"):
+        raise ValueError(
+            f"kv_dtype must be None, 'native', or 'int8', got {kv_dtype!r}")
     if dtype is None:
         dtype = precision_lib.resolve(model.precision, model.dtype)[0]
     head_dim = model.width // model.num_heads
     shape = (num_pages + 1, page_size, model.num_heads, head_dim)
+    if kv_dtype == "int8":
+        return tuple({"k": jnp.zeros(shape, jnp.int8),
+                      "v": jnp.zeros(shape, jnp.int8),
+                      "k_scale": jnp.zeros(num_pages + 1, jnp.float32),
+                      "v_scale": jnp.zeros(num_pages + 1, jnp.float32)}
+                     for _ in range(model.num_layers))
     return tuple({"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
                  for _ in range(model.num_layers))
 
 
-def page_bytes(model: CausalLM, page_size: int, dtype=None) -> int:
+#: levels of the symmetric int8 KV grid — precision.py's ``_INT8_LEVELS``
+#: (codes -127..127 after centering), so KV pages, wire commits, and
+#: fake-quant training share one affine arithmetic.
+KV_QUANT_LEVELS = 254
+
+
+def quantize_kv_page(x, valid=None):
+    """Per-page symmetric int8 quantization of K/V page data.
+
+    ``x`` is ``[..., page_size, heads, head_dim]`` (leading dims index
+    pages); returns ``(codes int8, scale f32[...])`` on the wire codec's
+    grid: ``scale = affine_qparams(-amax, amax, 254) = amax / 127``
+    (``precision.symmetric_int8_qparams``), codes centered at zero.
+    ``valid`` (``[..., page_size]`` bool) masks cells past a row's
+    length so stale garbage can never inflate a page's scale; masked
+    cells store code 0. A single encode's per-cell round-trip error is
+    bounded by ``scale / 2`` (tests/test_decode_economics.py); pages
+    fill monotonically under the serving engine, so a cell is re-encoded
+    at most ``page_size`` times before its page's codes freeze."""
+    from distkeras_tpu.comms import codec
+
+    x = jnp.asarray(x, jnp.float32)
+    if valid is not None:
+        x = jnp.where(valid[..., None, None], x, 0.0)
+    amax = jnp.max(jnp.abs(x), axis=(-3, -2, -1))
+    scale = precision_lib.symmetric_int8_qparams(amax)
+    sc = scale[..., None, None, None]
+    codes = codec.affine_quantize(x, -amax[..., None, None, None], sc,
+                                  KV_QUANT_LEVELS, xp=jnp) - 127.0
+    codes = jnp.where(sc > 0, codes, 0.0)
+    return codes.astype(jnp.int8), scale
+
+
+def dequantize_kv_page(codes, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv_page` on the centered grid
+    (precision.py's rule with ``lo = 0`` after centering):
+    ``scale * codes``, broadcast per page."""
+    sc = jnp.asarray(scale)[..., None, None, None]
+    return (codes.astype(jnp.float32) * sc).astype(dtype)
+
+
+def _paged_int8_attention(q, k, v, cache, page_table, pos, cache_index,
+                          _fa):
+    """One decode/prefill step over int8 KV pages (module docstring).
+
+    Gather codes+scales through the page table into the dense
+    ``[b, max_len]`` view, dequantize, overlay the EXACT in-call K/V
+    block at ``pos`` (in-call positions attend at full precision — only
+    history is round-tripped), attend with the same fixed-length mask
+    as the native path, then requantize ONLY the statically-bounded
+    window of pages this block touched (``ceil(t / page_size) + 1``
+    pages from ``cache_index // page_size``); untouched pages keep
+    their frozen codes bit-for-bit, which is what makes host swap and
+    prefix-cache reuse of quantized pages lossless."""
+    b, t = pos.shape
+    heads, head_dim = k.shape[2], k.shape[3]
+    ps = cache["k"].shape[1]
+    pmax = page_table.shape[1]
+    max_len = pmax * ps
+    scratch_page = cache["k"].shape[0] - 1
+    rows = jnp.arange(b)[:, None]
+
+    def dense_view(codes, scale, block):
+        deq = (codes[page_table].astype(jnp.float32)
+               * scale[page_table][..., None, None, None])
+        view = deq.reshape(b, max_len, heads, head_dim)
+        # mode="drop": the decode ghost position (>= max_len) must not
+        # clamp onto the last real cell, same rule as the native path
+        return view.at[rows, pos].set(block.astype(jnp.float32),
+                                      mode="drop")
+    k_dense = dense_view(cache["k"], cache["k_scale"], k)
+    v_dense = dense_view(cache["v"], cache["v_scale"], v)
+    # requantize the touched window BEFORE attending so the optional
+    # kernel path can read a complete pool. Positions [cache_index,
+    # cache_index + t) span at most ceil(t/ps) + 1 logical pages
+    # starting at cache_index // ps (the cursor may sit mid-page).
+    n_touch = -(-t // ps) + 1
+    first = jnp.clip(cache_index // ps, 0, pmax - 1)
+    win = first[:, None] + jnp.arange(n_touch)[None, :]  # [b, n_touch]
+    last = jnp.clip((cache_index + t - 1) // ps, 0, pmax - 1)
+    ok_w = (win <= last[:, None]) & (win < pmax)
+    win_c = jnp.clip(win, 0, pmax - 1)
+    phys_w = jnp.where(ok_w,
+                       jnp.take_along_axis(page_table, win_c, axis=1),
+                       scratch_page)
+    cell = win_c[..., None] * ps + jnp.arange(ps)[None, None, :]
+    bidx = jnp.arange(b)[:, None, None]
+    # cells past the row's post-call length are zeroed before amax so a
+    # page's scale only reflects real tokens (incl. this call's block
+    # and its padding, which the native path also writes)
+    valid = cell < (cache_index + t)[:, None, None]
+    kq, ksc = quantize_kv_page(k_dense[bidx, cell], valid)
+    vq, vsc = quantize_kv_page(v_dense[bidx, cell], valid)
+    new_cache = {"k": cache["k"].at[phys_w].set(kq),
+                 "v": cache["v"].at[phys_w].set(vq),
+                 "k_scale": cache["k_scale"].at[phys_w].set(ksc),
+                 "v_scale": cache["v_scale"].at[phys_w].set(vsc)}
+    if _fa.PAGED_INT8_KERNEL and _fa.paged_dispatch(
+            q.shape, (scratch_page + 1, ps, heads, head_dim),
+            page_table.shape):
+        # follow-up flag (default OFF, the groupnorm lesson): feed the
+        # fused kernel a dequantized f32 pool so the page DMAs stay
+        # kernel-side. The pool already holds this call's block, so the
+        # kernel sees ROUND-TRIPPED in-call values where the XLA path
+        # overlays them exactly — a stepping stone, not a win, until
+        # the dequant moves inside the kernel grid (DESIGN.md §19).
+        k_pool = dequantize_kv_page(new_cache["k"], new_cache["k_scale"],
+                                    q.dtype)
+        v_pool = dequantize_kv_page(new_cache["v"], new_cache["v_scale"],
+                                    q.dtype)
+        out = _fa.paged_flash_attention(q, k_pool, v_pool, page_table,
+                                        cache_index,
+                                        interpret=_fa.PAGED_INTERPRET)
+    else:
+        key_pos = jnp.arange(max_len)
+        mask = key_pos[None, None, None, :] <= pos[:, None, :, None]
+        out = dot_product_attention(q, k_dense.astype(q.dtype),
+                                    v_dense.astype(q.dtype), mask=mask)
+    return out, new_cache
+
+
+def page_bytes(model: CausalLM, page_size: int, dtype=None,
+               kv_dtype=None) -> int:
     """HBM bytes one logical page costs (k + v cells across every
     layer) — the allocation unit the paged pool budgets in, replacing
-    the per-slot :func:`cache_bytes_per_row` rectangle."""
+    the per-slot :func:`cache_bytes_per_row` rectangle. With
+    ``kv_dtype="int8"`` a page is int8 codes plus one f32 scale per
+    (layer, k/v): ~4x smaller than f32 pages, ~2x smaller than bf16."""
+    if kv_dtype == "int8":
+        return (2 * model.num_layers * page_size * model.width
+                + 2 * model.num_layers * 4)
     if dtype is None:
         dtype = precision_lib.resolve(model.precision, model.dtype)[0]
     return (2 * model.num_layers * page_size * model.width

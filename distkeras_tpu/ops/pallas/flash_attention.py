@@ -62,6 +62,14 @@ USE_FLASH_ATTENTION = False
 #: oracle in tests/test_flash_attention.py); never set in production
 PAGED_INTERPRET = False
 
+#: opt-in int8-KV kernel stepping stone (DESIGN.md §19, ISSUE 20):
+#: when on AND the f32 shapes fit, the int8 paged step dequantizes the
+#: page POOL and runs the fused paged kernel over it instead of the XLA
+#: gather path. Default OFF per the groupnorm lesson — it reads
+#: round-tripped in-call values and wins nothing until the dequant moves
+#: inside the kernel grid; flip only behind a kernel_ablate.py receipt.
+PAGED_INT8_KERNEL = False
+
 #: default tile sizes — one MXU tile per dot
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128

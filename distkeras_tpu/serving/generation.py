@@ -55,12 +55,36 @@ changing any of the above:
   exactness") emits a token stream identical to plain greedy decode
   regardless of draft quality.
 
-All executables (prefill x buckets, decode/verify x ladder, page
-swap-in/out, draft prefill/decode) are still AOT-compiled in
-``__init__`` — the compile cache cannot grow under any traffic mix.
+Long-context serving economics (ISSUE 20) add three more opt-in
+levers, each behind its own kwarg and composing with all of the above:
 
-Greedy (argmax) decoding only, on the host — sampling policies remain
-an honest limit, DESIGN.md §14.
+- ``prefill_chunk=``: **chunked prefill** — instead of one bucket-wide
+  forward at admission, a long prompt is sliced into ``prefill_chunk``-
+  token pieces ridden between decode iterations (one chunk per
+  partially-prefilled slot per iteration). A slot carries a
+  ``prefill_pos`` cursor and never enters a decode group until the
+  cursor covers its prompt, so one user's TTFT stops taxing everyone
+  else's tokens/s. Chunks reuse the paged step family at
+  ``lengths=[cursor]`` (mid-sequence prefill), so every chunk's logits
+  are bitwise the one-shot prefill's rows — the §14 fixed-contraction-
+  length masked-softmax argument covers mid-sequence positions.
+- ``kv_dtype="int8"``: **quantized KV pages** — the paged pool stores
+  per-page symmetric int8 codes + f32 scales (models/gpt.py, the wire
+  codec's affine rule), ~4x resident conversations per HBM byte at f32
+  compute with a ``scale/2``-per-cell error bound; host swap, prefix
+  cache, and fleet KV handoff ship the quantized blobs.
+- ``sampling=True``: **temperature sampling** with a per-request
+  seeded stream (``seed``/``temperature`` kwargs; one inverse-CDF
+  uniform per emitted token), and — combined with ``draft=``/
+  ``spec_k=`` — **sampling-capable speculative verification**: the
+  standard target-vs-draft accept/reject rule, realized for this
+  repo's deterministic (point-mass) drafts so the emitted stream is
+  seeded-IDENTICAL to plain sampled decode (NUMERICS.md "Sampled
+  speculative equivalence").
+
+All executables (prefill x buckets, prefill-chunk, decode/verify x
+ladder, page swap-in/out, draft prefill/decode) are still AOT-compiled
+in ``__init__`` — the compile cache cannot grow under any traffic mix.
 """
 
 from __future__ import annotations
@@ -185,8 +209,9 @@ def make_paged_step_fn(model):
     """Pure ``(params, pages, page_tables[n, Pmax], tokens[n, T],
     lengths[n]) -> (pages', logits[n, T, V])`` — the ONE compiled shape
     family for every paged phase. Prefill is n=1/T=bucket at
-    ``lengths=[start]`` (start > 0 = suffix prefill after a prefix-cache
-    hit), decode is T=2 (token + ghost), verify is T=spec_k+1. The
+    ``lengths=[start]`` (start > 0 = mid-sequence prefill: a suffix
+    after a prefix-cache hit, or one chunk of a chunked prefill at its
+    cursor), decode is T=2 (token + ghost), verify is T=spec_k+1. The
     model's paged write-back routes every cell to its physical page;
     ghost/overflow cells land in the scratch page."""
 
@@ -418,7 +443,7 @@ class GenerationResult:
 class _GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "eos_id", "stream", "future",
                  "t_submit", "deadline", "generated", "last_token",
-                 "last_logits", "trace", "t_perf")
+                 "last_logits", "trace", "t_perf", "prefill_pos", "rng")
 
     def __init__(self, prompt, max_new_tokens, eos_id, stream,
                  t_submit, deadline, trace=None):
@@ -431,6 +456,14 @@ class _GenRequest:
         self.deadline = deadline
         self.generated: list = []
         self.last_token: int = 0
+        #: chunked-prefill cursor: prompt positions [0, prefill_pos) are
+        #: cached; the slot joins the decode set only at prompt.size
+        self.prefill_pos: int = 0
+        #: per-request sampled-decode stream (``sampling=True`` only):
+        #: seeded from (engine seed, submission index), consumed one
+        #: uniform per EMITTED token — the coupling that makes sampled
+        #: speculative output stream-identical to plain sampling
+        self.rng = None
         #: logits row that produced the newest token (kept only when a
         #: prefix cache is attached — retirement parks them so a resumed
         #: conversation's full hit can emit with zero forwards)
@@ -467,7 +500,11 @@ class GenerationEngine:
                  page_size: Optional[int] = None,
                  num_pages: Optional[int] = None,
                  prefix_cache_bytes: int = 0,
-                 draft=None, spec_k: int = 0):
+                 draft=None, spec_k: int = 0,
+                 prefill_chunk: Optional[int] = None,
+                 kv_dtype: Optional[str] = None,
+                 sampling: bool = False, temperature: float = 1.0,
+                 seed: int = 0):
         import jax
 
         self.model = model
@@ -503,10 +540,38 @@ class GenerationEngine:
                              f"{spec_k}")
         self._draft = draft
         self._spec_k = int(spec_k)
+        self._chunk = None if prefill_chunk is None else int(prefill_chunk)
+        if self._chunk is not None:
+            if not self._paged:
+                raise ValueError(
+                    "prefill_chunk requires page_size: chunked prefill "
+                    "rides the paged step family's mid-sequence prefill")
+            if self._chunk < 2:
+                # a 1-token chunk would put the chunk call on the M=1
+                # gemv path and break chunked-vs-one-shot bitwise parity
+                # (module docstring)
+                raise ValueError(
+                    f"prefill_chunk must be >= 2, got {prefill_chunk}")
+            if self._chunk > self.max_len:
+                raise ValueError(
+                    f"prefill_chunk {prefill_chunk} exceeds model "
+                    f"max_len {self.max_len}")
+        if kv_dtype is not None and not self._paged:
+            raise ValueError(
+                "kv_dtype requires page_size: quantized KV is a "
+                "page-pool format")
+        self._sampling = bool(sampling)
+        self._temperature = float(temperature)
+        if self._sampling and self._temperature <= 0:
+            raise ValueError(
+                f"temperature must be > 0, got {temperature}")
+        self._seed = int(seed)
+        self._req_seq = 0  # submission index: per-request stream ids
         if self._paged:
             self.pool = PagedKVCachePool(
                 model, num_slots, page_size=page_size, num_pages=num_pages,
-                device=device, dtype=dtype, hbm_fraction=hbm_fraction)
+                device=device, dtype=dtype, kv_dtype=kv_dtype,
+                hbm_fraction=hbm_fraction)
         else:
             self.pool = KVCachePool(model, num_slots, device=device,
                                     dtype=dtype, hbm_fraction=hbm_fraction)
@@ -572,6 +637,21 @@ class GenerationEngine:
             "serving.decode.prefix.imports")
         self._prefix_exports_c = telemetry.counter(
             "serving.decode.prefix.exports")
+        if self._chunk is not None:
+            # created only when chunking is on so the health CLI's
+            # DECODE line gains the field exactly when it means something
+            self._chunk_admits_c = telemetry.counter(
+                "serving.decode.chunk.admitted")
+            self._chunk_steps_c = telemetry.counter(
+                "serving.decode.chunk.steps")
+            self._chunk_depth_g = telemetry.gauge(
+                "serving.decode.chunk.queue_depth")
+            self._chunk_depth_g.set(0)
+        if self._sampling and self._spec_k:
+            self._spec_s_accepts_c = telemetry.counter(
+                "serving.decode.spec.sampled_accepts")
+            self._spec_s_resamples_c = telemetry.counter(
+                "serving.decode.spec.sampled_resamples")
 
         self._compile_all()
         if self._draft is not None:
@@ -601,6 +681,7 @@ class GenerationEngine:
         self._prefill_exec = {}
         self._decode_exec = {}
         self._verify_exec = {}
+        self._chunk_exec = None
         self._swap_out_exec = None
         self._swap_in_exec = None
         if self._paged:
@@ -613,6 +694,20 @@ class GenerationEngine:
                             p_sds, pool_sds, i32(1, pmax), i32(1, lb),
                             i32(1)).compile()
                 compiles.inc()
+            if self._chunk is not None:
+                if self._chunk in self._prefill_exec:
+                    # a chunk the width of a prefill bucket is the SAME
+                    # compiled shape — share the executable (both calls
+                    # donate the pool; the executable is stateless)
+                    self._chunk_exec = self._prefill_exec[self._chunk]
+                else:
+                    with telemetry.span("serving.decode.compile",
+                                        prefill_chunk=self._chunk):
+                        self._chunk_exec = jax.jit(
+                            step, donate_argnums=(1,)).lower(
+                                p_sds, pool_sds, i32(1, pmax),
+                                i32(1, self._chunk), i32(1)).compile()
+                    compiles.inc()
             for n in self._ladder:
                 with telemetry.span("serving.decode.compile", lanes=n):
                     self._decode_exec[n] = jax.jit(
@@ -695,6 +790,13 @@ class GenerationEngine:
                         self._params, self.pool.pool, pts,
                         np.zeros((n, self._spec_k + 1), np.int32), zeros)
                     self.pool.swap(new_pool)
+                if (self._chunk_exec is not None
+                        and self._chunk not in self._prefill_exec):
+                    new_pool, _ = self._chunk_exec(
+                        self._params, self.pool.pool, spt[None, :],
+                        np.zeros((1, self._chunk), np.int32),
+                        np.zeros(1, np.int32))
+                    self.pool.swap(new_pool)
                 if self._swap_out_exec is not None:
                     ids = np.full(pmax, self.pool.scratch_page, np.int32)
                     data = self._swap_out_exec(self.pool.pool, ids)
@@ -726,11 +828,14 @@ class GenerationEngine:
         """{"prefill": bucket sizes, "decode": lane widths} actually
         compiled — tests assert this equals the declared ladders and
         never grows. Optional features add their own (equally fixed)
-        keys: "verify" lane widths under speculative decoding, "swap"
-        under the prefix cache, "draft_prefill"/"draft_decode" with a
+        keys: "prefill_chunk" under chunked prefill, "verify" lane
+        widths under speculative decoding, "swap" under the prefix
+        cache, "draft_prefill"/"draft_decode" with a
         :class:`ModelDraft` attached."""
         execs = {"prefill": tuple(sorted(self._prefill_exec)),
                  "decode": tuple(sorted(self._decode_exec))}
+        if self._chunk_exec is not None:
+            execs["prefill_chunk"] = (self._chunk,)
         if self._verify_exec:
             execs["verify"] = tuple(sorted(self._verify_exec))
         if self._swap_in_exec is not None:
@@ -976,6 +1081,13 @@ class GenerationEngine:
                 raise QueueFull(
                     f"generation queue at {len(self._dq)}/"
                     f"{self.queue_capacity}")
+            if self._sampling:
+                # stream id = (engine seed, submission index): two
+                # engines fed the same requests in the same order draw
+                # identical streams — the sampled-spec identity oracle
+                req.rng = np.random.default_rng([self._seed,
+                                                 self._req_seq])
+                self._req_seq += 1
             self._dq.append(req)
             self._depth_g.set(len(self._dq))
             self._cv.notify()
@@ -984,11 +1096,13 @@ class GenerationEngine:
     # -- scheduler ---------------------------------------------------------
 
     def _scheduler_loop(self) -> None:
-        active = {}  # slot -> _GenRequest
+        active = {}      # slot -> _GenRequest (decoding)
+        prefilling = {}  # slot -> _GenRequest (chunked prefill cursor)
         try:
             while True:
                 with self._cv:
-                    while not self._dq and not active and not self._closed \
+                    while not self._dq and not active and not prefilling \
+                            and not self._closed \
                             and self._pending_swap is None \
                             and not self._host_ops:
                         self._cv.wait()
@@ -997,15 +1111,18 @@ class GenerationEngine:
                         self._dq.clear()
                         self._depth_g.set(0)
                         break
-                    if self._closed and not self._dq and not active:
+                    if self._closed and not self._dq and not active \
+                            and not prefilling:
                         self._fail_pending_swap(EngineClosed(
                             "engine is shut down; no weight swaps"))
                         self._fail_host_ops()
                         return
                 self._apply_pending_swap()
                 self._apply_host_ops()
-                self._admit(active)
-                self._expire(active)
+                self._admit(active, prefilling)
+                self._expire(active, prefilling)
+                if prefilling:
+                    self._chunk_step(active, prefilling)
                 if active:
                     self._decode_step(active)
         except BaseException as e:  # scheduler must never die silently
@@ -1021,9 +1138,10 @@ class GenerationEngine:
             err = EngineClosed(f"generation scheduler failed: {e!r}")
             self._fail_pending_swap(err)
             self._fail_host_ops()
-            for req in pending + list(active.values()):
+            for req in (pending + list(active.values())
+                        + list(prefilling.values())):
                 req.future.set_exception(err)
-            for slot in list(active):
+            for slot in list(active) + list(prefilling):
                 self.pool.free(slot)
             self._slot_version.clear()
             raise
@@ -1031,16 +1149,19 @@ class GenerationEngine:
         err = EngineClosed("engine shut down without draining")
         self._fail_pending_swap(err)
         self._fail_host_ops()
-        for req in pending + list(active.values()):
+        for req in (pending + list(active.values())
+                    + list(prefilling.values())):
             req.future.set_exception(err)
-        for slot in list(active):
+        for slot in list(active) + list(prefilling):
             self.pool.free(slot)
         self._slot_version.clear()
         self._active_g.set(0)
 
-    def _admit(self, active) -> None:
+    def _admit(self, active, prefilling=None) -> None:
         """Move queued requests into free slots (prefill each). Runs
-        every iteration — admission interleaves with in-flight decode."""
+        every iteration — admission interleaves with in-flight decode.
+        Under chunked prefill a request parks in ``prefilling`` with a
+        cursor instead of paying its whole prefill here."""
         while self.pool.num_free > 0:
             with self._cv:
                 if not self._dq:
@@ -1070,6 +1191,19 @@ class GenerationEngine:
                     self._dq.appendleft(req)
                     self._depth_g.set(len(self._dq))
                 return
+            if self._chunk is not None:
+                parked = self._start_chunked(req, slot, prefilling)
+                self._admitted_c.inc()
+                if parked:
+                    self._chunk_admits_c.inc()
+                    self._chunk_depth_g.set(len(prefilling))
+                    continue
+                # a full prefix hit needs no chunk work: it completed
+                # through the normal zero-forward path above
+                if self._emit(req, slot) is None:
+                    active[slot] = req
+                self._active_g.set(len(active))
+                continue
             if self._paged:
                 self._prefill_paged(req, slot)
             else:
@@ -1094,7 +1228,7 @@ class GenerationEngine:
         self._slot_version[slot] = self.model_version
         self.pool.swap(new_pool)
         self.pool.lengths[slot] = n
-        tok = int(np.argmax(np.asarray(logits)))
+        tok = self._pick_token(req, np.asarray(logits))
         now = time.monotonic()
         self._prefills_c.inc()
         self._prefill_h.record(now - t0)
@@ -1110,14 +1244,14 @@ class GenerationEngine:
             self._draft.begin(slot, req.prompt, tok)
         self._stream_token(req, tok)
 
-    def _prefill_paged(self, req: _GenRequest, slot: int) -> None:
-        """Paged admission: prefix-cache lookup, page swap-in, then a
-        suffix (or full) prefill of whatever the cache didn't cover. A
-        full hit with parked logits emits the first token with ZERO
-        forward calls."""
+    def _prefix_start(self, req: _GenRequest, slot: int):
+        """Prefix-cache half of paged admission: lookup + page swap-in.
+        Returns ``(start, logits_row, hit)``: cached positions
+        ``[0, start)`` are resident in ``slot``; ``logits_row`` is
+        non-None on a full hit with parked logits (the caller emits
+        with ZERO forward calls); ``hit`` reports whether any cached
+        prefix was restored (the trace span's ``prefix_hit``)."""
         n = req.prompt.size
-        t0 = time.monotonic()
-        tp0 = time.perf_counter()
         entry = (self._prefix.lookup(req.prompt)
                  if self._prefix is not None else None)
         start = 0
@@ -1125,33 +1259,30 @@ class GenerationEngine:
             start = entry.length
         else:
             entry = None
-        logits_row = None
         if entry is not None and start == n:
             if entry.last_logits is not None:
                 # full hit: the parked logits ARE the first-token
                 # distribution — no device math at all
-                logits_row = entry.last_logits
                 self._prefix_full_c.inc()
-            else:
-                # KV covers the prompt but the logits weren't parked;
-                # re-derive them by re-feeding the final prompt token
-                start = n - 1
-        self.pool.lengths[slot] = start
-        ran_prefill = logits_row is None
-        if ran_prefill:
-            suffix = req.prompt[start:]
-            lb = self._buckets.bucket_for(suffix.size)
-            ids = np.zeros((1, lb), np.int32)
-            ids[0, :suffix.size] = req.prompt[start:]
-            pts = self.pool.page_table_row(slot)[None, :]
-            new_pool, logits = self._prefill_exec[lb](
-                self._params, self.pool.pool, pts, ids,
-                np.full(1, start, np.int32))
-            self.pool.swap(new_pool)
-            logits_row = np.asarray(logits)[0, n - start - 1]
-        self._slot_version[slot] = self.model_version
+                return n, entry.last_logits, True
+            # KV covers the prompt but the logits weren't parked;
+            # re-derive them by re-feeding the final prompt token
+            start = n - 1
+        return start, None, entry is not None
+
+    def _finish_prefill(self, req: _GenRequest, slot: int, logits_row,
+                        ran_prefill: bool, t0: float, tp0: float,
+                        prefix_hit: bool) -> None:
+        """Shared tail of every paged prefill path (one-shot, chunked,
+        full hit): version pin, first-token pick, TTFT accounting,
+        prefix capture, draft begin, stream."""
+        n = req.prompt.size
+        # setdefault: a chunked slot pinned its version at admission
+        # and must NOT re-pin to a newer one a mid-prefill swap installed
+        version = self._slot_version.setdefault(slot, self.model_version)
         self.pool.lengths[slot] = n
-        tok = int(np.argmax(logits_row))
+        logits_row = np.asarray(logits_row)
+        tok = self._pick_token(req, logits_row)
         now = time.monotonic()
         if ran_prefill:
             self._prefills_c.inc()
@@ -1161,17 +1292,113 @@ class GenerationEngine:
             telemetry.record_trace_span(
                 req.trace, "trace.prefill", tp0,
                 time.perf_counter() - tp0, slot=slot,
-                prefix_hit=entry is not None,
-                model_version=self.model_version)
+                prefix_hit=prefix_hit,
+                model_version=version)
         req.generated.append(tok)
         req.last_token = tok
         if self._prefix is not None:
-            req.last_logits = np.asarray(logits_row).copy()
-            if entry is None or entry.length < n:
-                self._capture_prefix(slot, req.prompt, req.last_logits)
+            req.last_logits = logits_row.copy()
+            # _capture_prefix's has() check already skips re-parking a
+            # prompt the cache holds (incl. the full-hit path)
+            self._capture_prefix(slot, req.prompt, req.last_logits)
         if self._draft is not None:
             self._draft.begin(slot, req.prompt, tok)
         self._stream_token(req, tok)
+
+    def _prefill_paged(self, req: _GenRequest, slot: int) -> None:
+        """Paged admission: prefix-cache lookup, page swap-in, then a
+        suffix (or full) prefill of whatever the cache didn't cover. A
+        full hit with parked logits emits the first token with ZERO
+        forward calls."""
+        n = req.prompt.size
+        t0 = time.monotonic()
+        tp0 = time.perf_counter()
+        start, logits_row, hit = self._prefix_start(req, slot)
+        self.pool.lengths[slot] = start
+        ran_prefill = logits_row is None
+        if ran_prefill:
+            suffix = req.prompt[start:]
+            lb = self._buckets.bucket_for(suffix.size)
+            ids = np.zeros((1, lb), np.int32)
+            ids[0, :suffix.size] = suffix
+            pts = self.pool.page_table_row(slot)[None, :]
+            new_pool, logits = self._prefill_exec[lb](
+                self._params, self.pool.pool, pts, ids,
+                np.full(1, start, np.int32))
+            self.pool.swap(new_pool)
+            logits_row = np.asarray(logits)[0, n - start - 1]
+        self._finish_prefill(req, slot, logits_row, ran_prefill, t0, tp0,
+                             hit)
+
+    def _start_chunked(self, req: _GenRequest, slot: int,
+                       prefilling) -> bool:
+        """Chunked admission (module docstring): the prefix half of
+        :meth:`_prefill_paged`, but instead of one bucket-wide prefill
+        the request parks in ``prefilling`` with a ``prefill_pos``
+        cursor; :meth:`_chunk_step` advances it one chunk per scheduler
+        iteration, riding between decode steps. Returns False when no
+        chunk work is needed (a full prefix hit with parked logits
+        completes here with zero forwards)."""
+        t0 = time.monotonic()
+        tp0 = time.perf_counter()
+        start, logits_row, hit = self._prefix_start(req, slot)
+        if logits_row is not None:
+            self.pool.lengths[slot] = start
+            self._finish_prefill(req, slot, logits_row,
+                                 ran_prefill=False, t0=t0, tp0=tp0,
+                                 prefix_hit=hit)
+            return False
+        self.pool.lengths[slot] = start
+        # pin the version NOW: every chunk (and later decode step) for
+        # this slot runs on the params it was admitted under, even if a
+        # weight swap lands mid-prefill
+        self._slot_version[slot] = self.model_version
+        req.prefill_pos = start
+        prefilling[slot] = req
+        return True
+
+    def _chunk_step(self, active, prefilling) -> None:
+        """Advance every partially-prefilled slot by ONE chunk: a
+        T=prefill_chunk mid-sequence prefill call at the slot's cursor
+        (``lengths=[cursor]``, the same hook suffix prefill uses), so a
+        long prompt costs each in-flight decoder one chunk of latency
+        per iteration instead of the whole prefill at once. A slot
+        enters the decode set only when its cursor covers the prompt —
+        a partially-prefilled slot is never in a decode group. Chunk
+        logits are bitwise the one-shot prefill's rows (NUMERICS.md
+        "Decode-step equivalence" covers mid-sequence positions), so
+        the final chunk's last-token row IS the first-token
+        distribution."""
+        for slot in sorted(prefilling):
+            req = prefilling[slot]
+            n = req.prompt.size
+            pos = req.prefill_pos
+            t0 = time.monotonic()
+            tp0 = time.perf_counter()
+            chunk = req.prompt[pos:pos + self._chunk]
+            ids = np.zeros((1, self._chunk), np.int32)
+            ids[0, :chunk.size] = chunk
+            pts = self.pool.page_table_row(slot)[None, :]
+            params = self._versions.get(
+                self._slot_version.get(slot, self.model_version),
+                self._params)
+            new_pool, logits = self._chunk_exec(
+                params, self.pool.pool, pts, ids,
+                np.full(1, pos, np.int32))
+            self.pool.swap(new_pool)
+            self._chunk_steps_c.inc()
+            req.prefill_pos = pos + chunk.size
+            self.pool.lengths[slot] = req.prefill_pos
+            if req.prefill_pos >= n:
+                logits_row = np.asarray(logits)[0, n - pos - 1]
+                del prefilling[slot]
+                self._finish_prefill(req, slot, logits_row,
+                                     ran_prefill=True, t0=t0, tp0=tp0,
+                                     prefix_hit=False)
+                if self._emit(req, slot) is None:
+                    active[slot] = req
+                self._active_g.set(len(active))
+        self._chunk_depth_g.set(len(prefilling))
 
     def _swap_in_entry(self, slot: int, entry) -> bool:
         """Restore a parked prefix's pages into ``slot``'s reservation.
@@ -1212,6 +1439,24 @@ class GenerationEngine:
         data = jax.tree.map(lambda a: np.asarray(a)[:p0].copy(), data)
         self._swapped_out_c.inc(p0)
         self._prefix.insert(tokens, data, last_logits)
+
+    def _pick_token(self, req: _GenRequest, logits_row) -> int:
+        """Greedy argmax, or — under ``sampling=True`` — ONE inverse-CDF
+        draw from the tempered softmax on the request's own seeded
+        stream. One uniform per emitted token, consumed in emission
+        order: the coupling the sampled speculative walk reproduces
+        exactly (NUMERICS.md "Sampled speculative equivalence"). Host
+        float64 softmax/cumsum keeps the CDF deterministic across
+        engines fed the same f32 logits."""
+        if not self._sampling:
+            return int(np.argmax(logits_row))
+        z = np.asarray(logits_row, np.float64) / self._temperature
+        z -= z.max()
+        p = np.exp(z)
+        cdf = np.cumsum(p / p.sum())
+        u = req.rng.random()
+        return int(min(np.searchsorted(cdf, u, side="right"),
+                       cdf.size - 1))
 
     def _decode_step(self, active) -> None:
         """One scheduler iteration of decode. Slots are grouped BY PINNED
@@ -1291,7 +1536,7 @@ class GenerationEngine:
         for i, s in enumerate(slots):
             req = active[s]
             self.pool.lengths[s] += 1  # the fed token is now cached
-            tok = int(np.argmax(logits[i]))
+            tok = self._pick_token(req, logits[i])
             req.generated.append(tok)
             req.last_token = tok
             if self._prefix is not None:
@@ -1312,6 +1557,37 @@ class GenerationEngine:
             if reason is not None:
                 del active[s]
 
+    def _sampled_accept_walk(self, req: _GenRequest, props_i, logits_i):
+        """Host side of sampling-capable speculative verification
+        (NUMERICS.md "Sampled speculative equivalence"). The standard
+        target-vs-draft rule — accept draft token d with probability
+        ``min(1, p_target(d) / p_draft(d))``, resample from the
+        normalized residual ``max(p_target - p_draft, 0)`` on reject —
+        realized for the point-mass drafts this repo ships (Ngram/
+        ModelDraft propose deterministically, so p_draft is 1 on the
+        proposal): ONE tempered inverse-CDF draw per position accepts
+        the proposal iff the draw lands on it (probability p_target(d)
+        = min(1, p_target(d)/1)), and otherwise the SAME draw is
+        exactly a normalized-residual sample (p_target conditioned off
+        d). One uniform per EMITTED token, in emission order — the
+        stream plain sampled decode consumes, so output is seeded-
+        identical to no-draft sampling. Returns ``(emit, resampled)``;
+        caps (max_new_tokens, EOS) apply inside the walk so no draw is
+        ever consumed for a token that isn't emitted."""
+        s = self._spec_k
+        emit: list = []
+        resampled = False
+        remaining = req.max_new_tokens - len(req.generated)
+        for m in range(min(s + 1, remaining)):
+            tok = self._pick_token(req, logits_i[m])
+            emit.append(tok)
+            if req.eos_id is not None and tok == req.eos_id:
+                break
+            if m < s and tok != int(props_i[m]):
+                resampled = True
+                break
+        return emit, resampled
+
     def _spec_group(self, active, slots, version: int) -> None:
         """One draft-verify iteration: the draft proposes ``spec_k``
         tokens per lane, ONE verify call scores every proposal, and the
@@ -1319,7 +1595,10 @@ class GenerationEngine:
         i+1 is emitted iff proposals 1..i all matched what greedy would
         have produced, plus the one free token the verify call always
         yields. Output is token-for-token what sequential greedy decode
-        emits (NUMERICS.md "Speculative accept/reject exactness")."""
+        emits (NUMERICS.md "Speculative accept/reject exactness").
+        Under ``sampling=True`` the walk is the sampled accept/reject
+        rule instead (:meth:`_sampled_accept_walk`) — stream-identical
+        to plain sampled decode rather than to greedy."""
         params = self._versions.get(version, self._params)
         n = len(slots)
         s = self._spec_k
@@ -1350,17 +1629,26 @@ class GenerationEngine:
         emitted_total = 0
         for i, slot in enumerate(slots):
             req = active[slot]
-            m = 0
-            while m < s and props[i, m] == greedy[i, m]:
-                m += 1
-            emit = [int(t) for t in greedy[i, :m + 1]]
-            # caps: never emit past max_new_tokens, truncate at EOS
-            emit = emit[:req.max_new_tokens - len(req.generated)]
-            if req.eos_id is not None and req.eos_id in emit:
-                emit = emit[:emit.index(req.eos_id) + 1]
+            if self._sampling:
+                emit, resampled = self._sampled_accept_walk(
+                    req, props[i], logits[i])
+            else:
+                m = 0
+                while m < s and props[i, m] == greedy[i, m]:
+                    m += 1
+                emit = [int(t) for t in greedy[i, :m + 1]]
+                # caps: never emit past max_new_tokens, truncate at EOS
+                emit = emit[:req.max_new_tokens - len(req.generated)]
+                if req.eos_id is not None and req.eos_id in emit:
+                    emit = emit[:emit.index(req.eos_id) + 1]
+                resampled = False
             p = len(emit)
             self._spec_proposed_c.inc(s)
             self._spec_accepted_c.inc(p - 1)
+            if self._sampling:
+                self._spec_s_accepts_c.inc(p - 1)
+                if resampled:
+                    self._spec_s_resamples_c.inc()
             self.pool.lengths[slot] += p  # cells L..L+p-1 are now true
             for tok in emit:
                 req.generated.append(tok)
@@ -1422,28 +1710,34 @@ class GenerationEngine:
             GenerationResult(np.asarray(req.generated, np.int32), reason))
         return reason
 
-    def _expire(self, active) -> None:
-        """Fail in-flight sequences whose deadline passed mid-generation;
-        their slots free immediately (the mid-flight retirement path)."""
+    def _expire(self, active, prefilling=None) -> None:
+        """Fail in-flight sequences whose deadline passed mid-generation
+        (or mid-chunked-prefill); their slots free immediately (the
+        mid-flight retirement path)."""
         now = time.monotonic()
-        for slot in list(active):
-            req = active[slot]
-            if req.deadline is not None and now > req.deadline:
-                del active[slot]
-                self.pool.free(slot)
-                self._slot_version.pop(slot, None)
-                if self._draft is not None:
-                    self._draft.release(slot)
-                self._expired_c.inc()
-                telemetry.counter("serving.decode.retired",
-                                  reason="deadline").inc()
-                if req.trace is not None:
-                    telemetry.record_trace_span(
-                        req.trace, "trace.request", req.t_perf,
-                        time.perf_counter() - req.t_perf,
-                        reason="deadline", tokens=len(req.generated))
-                req.future.set_exception(DeadlineExceeded(
-                    f"deadline passed after {len(req.generated)} tokens"))
+        groups = [active]
+        if prefilling:
+            groups.append(prefilling)
+        for grp in groups:
+            for slot in list(grp):
+                req = grp[slot]
+                if req.deadline is not None and now > req.deadline:
+                    del grp[slot]
+                    self.pool.free(slot)
+                    self._slot_version.pop(slot, None)
+                    if self._draft is not None:
+                        self._draft.release(slot)
+                    self._expired_c.inc()
+                    telemetry.counter("serving.decode.retired",
+                                      reason="deadline").inc()
+                    if req.trace is not None:
+                        telemetry.record_trace_span(
+                            req.trace, "trace.request", req.t_perf,
+                            time.perf_counter() - req.t_perf,
+                            reason="deadline", tokens=len(req.generated))
+                    req.future.set_exception(DeadlineExceeded(
+                        f"deadline passed after {len(req.generated)} "
+                        f"tokens"))
         self._active_g.set(len(active))
 
     def _stream_token(self, req: _GenRequest, tok: int) -> None:
@@ -1487,6 +1781,21 @@ class GenerationEngine:
                 "page_occupancy": (self.pool.pages_in_use
                                    / self.pool.num_pages),
                 "page_bytes": self.pool.page_bytes,
+                "kv_dtype": self.pool.kv_dtype,
+            }
+            if self.pool.kv_dtype == "int8":
+                status["paged"]["kv_quant_bytes_saved"] = (
+                    self.pool.kv_quant_bytes_saved)
+        if self._chunk is not None:
+            status["chunked_prefill"] = {
+                "prefill_chunk": self._chunk,
+                "admitted": self._chunk_admits_c.value,
+                "chunk_steps": self._chunk_steps_c.value,
+            }
+        if self._sampling:
+            status["sampling"] = {
+                "temperature": self._temperature,
+                "seed": self._seed,
             }
         if self._prefix is not None:
             status["prefix_cache"] = {
@@ -1506,6 +1815,7 @@ class GenerationEngine:
                 "proposed": proposed,
                 "accepted": accepted,
                 "accept_rate": accepted / proposed if proposed else 0.0,
+                "sampling": self._sampling,
             }
         return status
 

@@ -34,6 +34,11 @@ plus a per-slot page table. A slot reserves only
 a long-tail length mix fits in a fraction of the rectangular
 reservation; page exhaustion surfaces exactly like slot exhaustion
 (admission blocks, ``QueueFull`` backpressure upstream).
+``kv_dtype="int8"`` stores pages as symmetric int8 codes with per-page
+f32 scales (models/gpt.py, "Int8 KV pages") — same table machinery,
+~4x the resident conversations per HBM byte at f32 compute, a stated
+``scale/2``-per-cell error bound, and quantized blobs everywhere the
+pool is treated as a pytree (host swap, prefix cache, fleet handoff).
 :class:`PrefixCache` is the host-RAM side of the same machinery:
 content-hashed KV prefixes (shared system prompts, parked/finished
 conversations) are swapped out page-by-page and swapped back in on a
@@ -172,11 +177,22 @@ class PagedKVCachePool:
 
     def __init__(self, model, num_slots: int, *, page_size: int = 16,
                  num_pages: Optional[int] = None, device=None,
-                 dtype=None, hbm_fraction: float = 0.8):
+                 dtype=None, kv_dtype: Optional[str] = None,
+                 hbm_fraction: float = 0.8):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if kv_dtype not in (None, "native", "int8"):
+            raise ValueError(
+                f"kv_dtype must be None, 'native', or 'int8', got "
+                f"{kv_dtype!r}")
         import jax
 
+        #: page storage format — "native" (compute dtype) or "int8"
+        #: (per-page affine codes + f32 scales, models/gpt.py
+        #: quantize_kv_page); a pytree-shape property, so host swap,
+        #: prefix cache, and fleet handoff ship whichever format the
+        #: pool holds with no format-specific code
+        self.kv_dtype = "int8" if kv_dtype == "int8" else "native"
         self.num_slots = int(num_slots)
         self.max_len = int(model.max_len)
         self.page_size = int(page_size)
@@ -193,8 +209,16 @@ class PagedKVCachePool:
             raise ValueError(
                 f"num_pages={self.num_pages} cannot back even one "
                 f"full-context slot ({self.pages_per_slot} pages)")
-        self.page_bytes = gpt_lib.page_bytes(model, self.page_size, dtype)
+        self.page_bytes = gpt_lib.page_bytes(model, self.page_size, dtype,
+                                             kv_dtype=kv_dtype)
         self.cache_bytes = self.page_bytes * (self.num_pages + 1)
+        #: bytes int8 pages save vs native-dtype pages at this pool's
+        #: geometry (0 for native pools) — the capacity headline
+        self.kv_quant_bytes_saved = 0
+        if self.kv_dtype == "int8":
+            native = gpt_lib.page_bytes(model, self.page_size, dtype)
+            self.kv_quant_bytes_saved = (
+                (native - self.page_bytes) * (self.num_pages + 1))
         stats = observability.hbm_stats(device)
         if stats and stats.get("limit_bytes"):
             budget = hbm_fraction * stats["limit_bytes"]
@@ -206,7 +230,8 @@ class PagedKVCachePool:
                     f"({hbm_fraction:.0%} of the device limit); lower "
                     f"num_pages or page_size")
         pool = gpt_lib.init_paged_cache(model, self.num_pages,
-                                        self.page_size, dtype)
+                                        self.page_size, dtype,
+                                        kv_dtype=kv_dtype)
         if device is not None:
             pool = jax.device_put(pool, device)
         #: live device pytree (the page pool); replaced wholesale by
@@ -229,6 +254,10 @@ class PagedKVCachePool:
         self._page_occ_g = telemetry.gauge(
             "serving.decode.paged.page_occupancy")
         self._page_occ_g.set(0.0)
+        if self.kv_dtype == "int8":
+            telemetry.gauge(
+                "serving.decode.paged.kv_quant_bytes_saved").set(
+                    self.kv_quant_bytes_saved)
 
     # -- slot/page lifecycle ----------------------------------------------
 

@@ -242,6 +242,7 @@ METRIC_NAMES = {
     "serving.decode.prefix.imports": "counter",
     "serving.decode.prefix.inserts": "counter",
     "serving.decode.prefix.misses": "counter",
+    "serving.decode.paged.kv_quant_bytes_saved": "gauge",
     "serving.decode.paged.page_occupancy": "gauge",
     "serving.decode.paged.pages_allocated": "counter",
     "serving.decode.paged.swap_in_failures": "counter",
@@ -251,6 +252,12 @@ METRIC_NAMES = {
     "serving.decode.spec.accepted": "counter",
     "serving.decode.spec.iterations": "counter",
     "serving.decode.spec.proposed": "counter",
+    "serving.decode.spec.sampled_accepts": "counter",
+    "serving.decode.spec.sampled_resamples": "counter",
+    # long-context serving economics (ISSUE 20): chunked prefill
+    "serving.decode.chunk.admitted": "counter",
+    "serving.decode.chunk.queue_depth": "gauge",
+    "serving.decode.chunk.steps": "counter",
     # live rollout / canary / rollback plane (serving/rollout.py,
     # DESIGN.md §18)
     "rollout.canary.agreement": "gauge",

@@ -96,7 +96,8 @@ def test_decode_bench_exposes_decode_leg_api():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     for leg in ("run_naive", "run_static", "run_continuous",
-                "run_prefix", "run_longtail", "run_speculative"):
+                "run_prefix", "run_longtail", "run_speculative",
+                "run_interference", "run_kv_capacity", "run_sampled"):
         assert callable(getattr(mod, leg)), leg
 
     path = os.path.join(REPO, "benchmarks", "paged_memory_probe.py")
